@@ -1,0 +1,3 @@
+"""Basis-state enumeration: portable NumPy path + native C++ kernels."""
+
+from . import host  # noqa: F401

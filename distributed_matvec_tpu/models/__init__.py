@@ -1,0 +1,3 @@
+"""Model front-end: expressions, symmetries, bases, operators, configs."""
+
+from . import basis, expression, lattices, operator, symmetry, yaml_io  # noqa: F401

@@ -1,0 +1,139 @@
+"""Model-family builders: the lattice geometries shipped with the reference.
+
+The reference's ``data/*.yaml`` covers Heisenberg chains (4–40 sites, with and
+without translation/parity/inversion sectors), square lattices 4x4–6x6, kagome
+12/16/36, and pyrochlore.  These builders generate the same edge lists (and the
+symmetric sectors used by the ``*_symm`` configs) programmatically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .basis import SpinBasis
+from .operator import Operator
+
+__all__ = [
+    "heisenberg_from_edges",
+    "chain_edges",
+    "square_edges",
+    "kagome_12_edges",
+    "kagome_16_edges",
+    "heisenberg_chain",
+    "heisenberg_square",
+    "heisenberg_kagome",
+]
+
+
+def heisenberg_from_edges(
+    basis: SpinBasis,
+    edges: Sequence[Tuple[int, int]],
+    coupling: float = 1.0,
+    extra: Sequence[Tuple[float, Sequence[Tuple[int, int]]]] = (),
+    spin_half_ops: bool = False,
+) -> Operator:
+    """Σ_⟨ij⟩ J (σˣᵢσˣⱼ + σʸᵢσʸⱼ + σᶻᵢσᶻⱼ) — the Hamiltonian of every reference
+    config.  ``spin_half_ops`` switches to S = σ/2 operators as used by the
+    kagome configs (data/heisenberg_kagome_16.yaml)."""
+    sym = "S" if spin_half_ops else "σ"
+    sites = [list(e) for e in edges]
+    prefix = "" if coupling == 1.0 else f"{coupling!r} × "
+    exprs = [
+        (f"{prefix}{sym}ˣ₀ {sym}ˣ₁", sites),
+        (f"{prefix}{sym}ʸ₀ {sym}ʸ₁", sites),
+        (f"{prefix}{sym}ᶻ₀ {sym}ᶻ₁", sites),
+    ]
+    for j, es in extra:
+        s = [list(e) for e in es]
+        exprs += [
+            (f"{j!r} × {sym}ˣ₀ {sym}ˣ₁", s),
+            (f"{j!r} × {sym}ʸ₀ {sym}ʸ₁", s),
+            (f"{j!r} × {sym}ᶻ₀ {sym}ᶻ₁", s),
+        ]
+    return Operator.from_expressions(basis, exprs, name="Heisenberg Hamiltonian")
+
+
+def chain_edges(n: int, periodic: bool = True) -> List[Tuple[int, int]]:
+    edges = [(i, i + 1) for i in range(n - 1)]
+    if periodic:
+        edges.append((n - 1, 0))
+    return edges
+
+
+def square_edges(nx: int, ny: int, periodic: bool = True) -> List[Tuple[int, int]]:
+    def idx(x, y):
+        return (y % ny) * nx + (x % nx)
+
+    edges = []
+    for y in range(ny):
+        for x in range(nx):
+            if periodic or x + 1 < nx:
+                edges.append((idx(x, y), idx(x + 1, y)))
+            if periodic or y + 1 < ny:
+                edges.append((idx(x, y), idx(x, y + 1)))
+    # Keep multiplicity: on a periodic torus with nx==2 or ny==2 the wrap bond
+    # doubles a nearest-neighbour bond, and both couplings are physical
+    # (chain_edges(2) likewise keeps [(0,1),(1,0)]).
+    return sorted(tuple(sorted(e)) for e in edges)
+
+
+# Kagome clusters — edge lists transcribed from data/heisenberg_kagome_{12,16}.yaml
+# (open boundary conditions; note those configs use S = σ/2 operators).
+def kagome_12_edges() -> List[Tuple[int, int]]:
+    return [
+        (0, 1), (0, 4), (1, 2), (1, 4), (2, 3), (2, 5), (3, 5),
+        (4, 6), (5, 7), (5, 8),
+        (6, 7), (6, 10), (7, 8), (7, 10), (8, 9), (8, 11), (9, 11),
+    ]
+
+
+def kagome_16_edges() -> List[Tuple[int, int]]:
+    return [
+        (0, 1), (0, 4), (1, 2), (1, 4), (2, 3), (2, 5), (3, 5), (4, 6),
+        (5, 7), (5, 8), (6, 7), (6, 10), (7, 8), (7, 10), (8, 9), (8, 11),
+        (9, 11), (10, 12), (11, 13), (11, 14), (12, 13), (13, 14), (14, 15),
+    ]
+
+
+def _translation(n: int) -> List[int]:
+    return [(i + 1) % n for i in range(n)]
+
+
+def _reflection(n: int) -> List[int]:
+    return [(n - 1) - i for i in range(n)]
+
+
+def heisenberg_chain(
+    n: int,
+    hamming_weight: Optional[int] = None,
+    symmetric: bool = False,
+    spin_inversion: Optional[int] = None,
+) -> Operator:
+    """Heisenberg ring; ``symmetric=True`` adds the translation+reflection
+    sector-0 generators of the ``*_symm`` configs (data/heisenberg_chain_24_symm.yaml)."""
+    if hamming_weight is None:
+        hamming_weight = n // 2
+    syms = []
+    if symmetric:
+        syms = [(_translation(n), 0), (_reflection(n), 0)]
+        if spin_inversion is None and 2 * hamming_weight == n:
+            spin_inversion = 1
+    basis = SpinBasis(n, hamming_weight, spin_inversion, syms)
+    return heisenberg_from_edges(basis, chain_edges(n))
+
+
+def heisenberg_square(nx: int, ny: int) -> Operator:
+    n = nx * ny
+    basis = SpinBasis(n, n // 2)
+    return heisenberg_from_edges(basis, square_edges(nx, ny))
+
+
+def heisenberg_kagome(n: int) -> Operator:
+    if n == 12:
+        edges = kagome_12_edges()
+    elif n == 16:
+        edges = kagome_16_edges()
+    else:
+        raise ValueError(f"no kagome cluster with {n} sites")
+    basis = SpinBasis(n, n // 2)
+    return heisenberg_from_edges(basis, edges, spin_half_ops=True)

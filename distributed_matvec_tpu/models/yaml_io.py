@@ -1,0 +1,59 @@
+"""YAML config loading — schema-compatible with the reference's ``data/*.yaml``.
+
+Reference behavior: ``loadConfigFromYaml(file, hamiltonian, observables)``
+(``/root/reference/src/ForeignTypes.chpl:261-288``) parses a YAML file with a
+``basis`` section, a ``hamiltonian`` section (list of ``{expression, sites}``
+terms), and optional ``observables``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import yaml
+
+from .basis import SpinBasis
+from .operator import Operator
+
+__all__ = ["Config", "load_config_from_yaml", "basis_from_dict", "operator_from_dict"]
+
+
+@dataclass
+class Config:
+    basis: SpinBasis
+    hamiltonian: Optional[Operator] = None
+    observables: List[Operator] = field(default_factory=list)
+
+
+def basis_from_dict(d: dict) -> SpinBasis:
+    return SpinBasis(
+        number_spins=d["number_spins"],
+        hamming_weight=d.get("hamming_weight"),
+        spin_inversion=d.get("spin_inversion"),
+        symmetries=[
+            (s["permutation"], s.get("sector", 0)) for s in d.get("symmetries", []) or []
+        ],
+    )
+
+
+def operator_from_dict(d: dict, basis: SpinBasis) -> Operator:
+    exprs = [(t["expression"], t["sites"]) for t in d["terms"]]
+    return Operator.from_expressions(basis, exprs, name=d.get("name", ""))
+
+
+def load_config_from_yaml(
+    path: str, hamiltonian: bool = True, observables: bool = True
+) -> Config:
+    with open(path, "r") as f:
+        raw = yaml.safe_load(f)
+    if "basis" not in raw:
+        raise ValueError(f"no 'basis' section in {path!r}")  # ForeignTypes.chpl:264-265
+    basis = basis_from_dict(raw["basis"])
+    cfg = Config(basis=basis)
+    if hamiltonian and "hamiltonian" in raw:
+        cfg.hamiltonian = operator_from_dict(raw["hamiltonian"], basis)
+    if observables:
+        for obs in raw.get("observables", []) or []:
+            cfg.observables.append(operator_from_dict(obs, basis))
+    return cfg

@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: config flags, logging, timers, I/O helpers."""
+
+from . import config, logging, timers  # noqa: F401

@@ -1,0 +1,85 @@
+"""Typed runtime configuration — the analog of the reference's ``config const``
+flag system (``/root/reference/src/CommonParameters.chpl:1-7`` plus per-module
+knobs, e.g. ``DistributedMatrixVector.chpl:456-460``).
+
+Chapel ``config const`` values are compile-time defaults overridable on the
+command line (``--kFlag=value``).  Here they are dataclass fields overridable
+via environment variables (``DMT_<NAME>=value``) or programmatically through
+:func:`get_config` / :func:`set_config`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+
+__all__ = ["RuntimeConfig", "get_config", "set_config", "update_config"]
+
+
+@dataclass
+class RuntimeConfig:
+    # -- observability (CommonParameters.chpl:2) ----------------------------
+    display_timings: bool = False          # kDisplayTimings
+    verbose_comm: bool = False             # kVerboseComm (DistributedMatrixVector.chpl:19)
+    log_debug: bool = False                # logDebug gating (FFI.chpl:78-80)
+
+    # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
+    is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize
+    enumerate_states_num_chunks_per_shard: int = 50  # kEnumerateStatesNumChunks / nL
+
+    # -- matvec engine (DistributedMatrixVector.chpl:456-460,55-57) ---------
+    remote_buffer_size: int = 150_000      # kRemoteBufferSize → all_to_all chunk capacity
+    matrix_vector_diagonal_num_chunks: int = 10   # per-shard row chunking of the diag kernel
+    matrix_vector_off_diagonal_num_chunks: int = 1  # row-block loop count (lax.scan length)
+    all_to_all_capacity_factor: float = 1.25  # padding headroom over mean bucket size
+
+    # -- device/layout ------------------------------------------------------
+    matvec_batch_size: int = 1 << 16       # row block B fed to the off-diag kernel
+    use_float32: bool = False              # accuracy contract needs f64; f32 for speed tests
+
+    # -- shuffles (CommonParameters.chpl:3-4) --------------------------------
+    block_to_hashed_num_chunks_factor: int = 2
+    hashed_to_block_num_chunks_factor: int = 2
+
+
+_ENV_PREFIX = "DMT_"
+_config: RuntimeConfig | None = None
+
+
+def _from_env(cfg: RuntimeConfig) -> RuntimeConfig:
+    for f in dataclasses.fields(cfg):
+        env = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if env is None:
+            continue
+        if f.type in ("bool", bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif f.type in ("int", int):
+            value = int(env)
+        elif f.type in ("float", float):
+            value = float(env)
+        else:
+            value = env
+        setattr(cfg, f.name, value)
+    return cfg
+
+
+def get_config() -> RuntimeConfig:
+    global _config
+    if _config is None:
+        _config = _from_env(RuntimeConfig())
+    return _config
+
+
+def set_config(cfg: RuntimeConfig) -> None:
+    global _config
+    _config = cfg
+
+
+def update_config(**kwargs) -> RuntimeConfig:
+    cfg = get_config()
+    for k, v in kwargs.items():
+        if not hasattr(cfg, k):
+            raise AttributeError(f"unknown config field {k!r}")
+        setattr(cfg, k, v)
+    return cfg

@@ -1,0 +1,39 @@
+"""Debug logging — ``logDebug`` parity (``/root/reference/src/FFI.chpl:78-80``:
+stderr lines prefixed ``[Debug] [<locale>]``; here the "locale" is the JAX
+process index)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from .config import get_config
+
+__all__ = ["log_debug", "log_info"]
+
+_START = time.time()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_debug(*parts) -> None:
+    if not get_config().log_debug:
+        return
+    msg = "".join(str(p) for p in parts)
+    print(
+        f"[Debug] [{_process_index()}] [{time.time() - _START:9.3f}] {msg}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+
+def log_info(*parts) -> None:
+    msg = "".join(str(p) for p in parts)
+    print(f"[Info] [{_process_index()}] {msg}", file=sys.stderr, flush=True)

@@ -1,0 +1,21 @@
+"""Test configuration.
+
+Multi-device testing strategy (SURVEY.md §4): the reference tests multi-locale
+runs via GASNet-smp oversubscription on one box; we use XLA's virtual CPU
+device pool instead — 8 virtual CPU devices, as the driver's multichip dry-run
+does.  Must be set before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_ENABLE_X64"] = "true"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

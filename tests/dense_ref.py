@@ -1,0 +1,150 @@
+"""Independent dense/sparse reference implementation used only by tests.
+
+This is the trusted path standing in for the reference's golden-data generator
+(``/root/reference/input_for_matvec.py``, which used the independent OpenMP
+``lattice_symmetries`` Python package).  It deliberately shares **no algebra**
+with the production code:
+
+  * operators are built as explicit Kronecker products of 2x2 matrices
+    (scipy.sparse), never via nonbranching masks;
+  * permutations act through the per-bit loop ``Permutation.apply_int``, never
+    via shift/mask networks;
+  * the symmetry-adapted matrix is ``B† H B`` with an explicitly materialized
+    isometry B of normalized projected basis vectors.
+
+Bit convention matches the package docs: bit i ↔ site i, bit 1 ↔ σᶻ = +1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+from distributed_matvec_tpu.models.expression import SymbolicExpression
+from distributed_matvec_tpu.models.symmetry import SymmetryGroup
+
+_PAULI = {
+    "I": np.eye(2, dtype=np.complex128),
+    # basis ordering: index 0 = bit 0 (down), index 1 = bit 1 (up)
+    "x": np.array([[0, 1], [1, 0]], dtype=np.complex128),
+    "y": np.array([[0, 1j], [-1j, 0]], dtype=np.complex128),  # [b_out, b_in]
+    "z": np.array([[-1, 0], [0, 1]], dtype=np.complex128),
+    "+": np.array([[0, 0], [1, 0]], dtype=np.complex128),  # |1⟩⟨0|? see note
+    "-": np.array([[0, 1], [0, 0]], dtype=np.complex128),
+    "n": np.array([[0, 0], [0, 1]], dtype=np.complex128),
+}
+# Note on σ±: with bit 1 = up, σ⁺ = |↑⟩⟨↓| maps bit 0 → bit 1, i.e. entry
+# M[1, 0] = 1.  σʸ: M[1,0] = ⟨↑|σʸ|↓⟩ = −i·(−1)... with the standard
+# (↑,↓)-ordered matrix [[0,−i],[i,0]] we have ⟨↑|σʸ|↓⟩ = −i ⇒ M[1,0] = −i and
+# M[0,1] = +i, which is what the array above encodes in [b_out, b_in] indexing.
+
+assert _PAULI["y"][1, 0] == -1j and _PAULI["y"][0, 1] == 1j
+
+
+def site_operator_matrix(n_sites: int, kind: str, site: int) -> sp.csr_matrix:
+    """Full 2^n matrix of a single-site operator via Kronecker products."""
+    mat = sp.identity(1, dtype=np.complex128, format="csr")
+    for i in range(n_sites):
+        m = _PAULI[kind] if i == site else _PAULI["I"]
+        # state index α = Σ b_i 2^i  ⇒  site 0 is the *fastest* index ⇒ it goes
+        # rightmost in the kron chain: M = M_{n-1} ⊗ … ⊗ M_0
+        mat = sp.kron(sp.csr_matrix(m), mat, format="csr")
+    return mat
+
+
+def expression_matrix(
+    n_sites: int,
+    expr: SymbolicExpression,
+    sites_rows: Sequence[Sequence[int]],
+) -> sp.csr_matrix:
+    """Full-space matrix of Σ_rows expr(row)."""
+    dim = 1 << n_sites
+    total = sp.csr_matrix((dim, dim), dtype=np.complex128)
+    for row in sites_rows:
+        row = list(row) if isinstance(row, (list, tuple)) else [row]
+        for term in expr.terms:
+            m = sp.identity(dim, dtype=np.complex128, format="csr") * term.coeff
+            for family, kind, placeholder in term.factors:
+                assert family == "spin", "dense path covers spin operators"
+                m = m @ site_operator_matrix(n_sites, kind, row[placeholder])
+            total = total + m
+    return total
+
+
+def operator_matrix_full(
+    n_sites: int,
+    exprs: Sequence[Tuple[SymbolicExpression, Sequence[Sequence[int]]]],
+) -> sp.csr_matrix:
+    dim = 1 << n_sites
+    total = sp.csr_matrix((dim, dim), dtype=np.complex128)
+    for expr, rows in exprs:
+        total = total + expression_matrix(n_sites, expr, rows)
+    return total
+
+
+def brute_force_representatives(
+    n_sites: int,
+    states: Sequence[int],
+    group: SymmetryGroup,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Orbit-minimum representatives + norms by per-element python loops."""
+    inv_mask = (1 << n_sites) - 1
+    reps: List[int] = []
+    norms: List[float] = []
+    for alpha in states:
+        orbit = []
+        stab_sum = 0.0 + 0.0j
+        for g, (perm, chi, flip) in enumerate(
+            zip(group.perms, group.characters, group.flip)
+        ):
+            beta = perm.apply_int(int(alpha))
+            if flip:
+                beta ^= inv_mask
+            orbit.append(beta)
+            if beta == alpha:
+                stab_sum += chi
+        norm2 = stab_sum.real / len(group.perms)
+        if min(orbit) == alpha and norm2 > 1e-12:
+            reps.append(alpha)
+            norms.append(np.sqrt(norm2))
+    return np.array(reps, dtype=np.uint64), np.array(norms)
+
+
+def symmetry_isometry(
+    n_sites: int,
+    reps: np.ndarray,
+    norms: np.ndarray,
+    group: SymmetryGroup,
+) -> sp.csr_matrix:
+    """B: [2^n, n_reps] with columns |r̃⟩ = (1/(|G|·‖P r‖)) Σ_g χ*(g) |g·r⟩."""
+    inv_mask = (1 << n_sites) - 1
+    dim = 1 << n_sites
+    cols, rows, vals = [], [], []
+    for j, (r, nrm) in enumerate(zip(reps, norms)):
+        amp: dict = {}
+        for perm, chi, flip in zip(group.perms, group.characters, group.flip):
+            beta = perm.apply_int(int(r))
+            if flip:
+                beta ^= inv_mask
+            amp[beta] = amp.get(beta, 0.0) + np.conj(chi)
+        for beta, a in amp.items():
+            a = a / (len(group.perms) * nrm)
+            if abs(a) > 1e-14:
+                rows.append(beta)
+                cols.append(j)
+                vals.append(a)
+    return sp.csr_matrix((vals, (rows, cols)), shape=(dim, len(reps)))
+
+
+def projected_matrix(
+    n_sites: int,
+    h_full: sp.csr_matrix,
+    reps: np.ndarray,
+    norms: np.ndarray,
+    group: SymmetryGroup,
+) -> np.ndarray:
+    b = symmetry_isometry(n_sites, reps, norms, group)
+    h_eff = (b.getH() @ h_full @ b).toarray()
+    return h_eff

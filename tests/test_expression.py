@@ -1,0 +1,145 @@
+"""Expression parser + nonbranching-term compiler vs the independent dense path."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from distributed_matvec_tpu.models.expression import (
+    NonbranchingTerm,
+    parse_expression,
+    simplify_terms,
+)
+
+import dense_ref
+
+
+def term_matrix(n_sites: int, t: NonbranchingTerm) -> np.ndarray:
+    """Materialize one nonbranching term by brute force over all states."""
+    dim = 1 << n_sites
+    m = np.zeros((dim, dim), dtype=np.complex128)
+    for alpha in range(dim):
+        v, beta = t.apply_int(alpha)
+        m[beta, alpha] += v
+    return m
+
+
+def expr_to_matrix_via_terms(n_sites, text, sites_rows):
+    expr = parse_expression(text)
+    dim = 1 << n_sites
+    total = np.zeros((dim, dim), dtype=np.complex128)
+    for row in sites_rows:
+        for t in expr.instantiate(row):
+            total += term_matrix(n_sites, t)
+    return total
+
+
+CASES = [
+    ("σˣ₀ σˣ₁", [[0, 1]]),
+    ("σʸ₀ σʸ₁", [[0, 1]]),
+    ("σᶻ₀ σᶻ₁", [[0, 1]]),
+    ("0.8 × σˣ₀ σˣ₁", [[1, 2]]),
+    ("σ⁺₀ σ⁻₁", [[0, 2]]),
+    ("σ⁺₀ σ⁻₁ + σ⁻₀ σ⁺₁", [[0, 1]]),
+    ("Sˣ₀ Sˣ₁", [[0, 1]]),
+    ("2 × σᶻ₀", [[0], [1], [2]]),
+    ("σˣ₀ σʸ₁ σᶻ₂", [[0, 1, 2]]),
+    ("σʸ₀", [[1]]),
+    ("σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁", [[0, 1], [1, 2], [2, 0]]),
+    ("1.5 × σ⁺₀", [[2]]),
+    ("σᶻ₀ σᶻ₁ - σˣ₀", [[0, 1]]),
+]
+
+
+@pytest.mark.parametrize("text,rows", CASES)
+def test_expression_matches_dense_kron(text, rows):
+    n = 3
+    expr = parse_expression(text)
+    ours = expr_to_matrix_via_terms(n, text, rows)
+    dense = dense_ref.expression_matrix(n, expr, rows).toarray()
+    np.testing.assert_allclose(ours, dense, atol=1e-14)
+
+
+def test_same_site_products_multiply():
+    # σ⁺σ⁻ on the same site = n (projector onto bit 1)
+    n = 2
+    ours = expr_to_matrix_via_terms(n, "σ⁺₀ σ⁻₀", [[0]])
+    expected = np.diag([0, 1, 0, 1]).astype(np.complex128)
+    np.testing.assert_allclose(ours, expected, atol=1e-14)
+
+
+def test_pauli_algebra_identities():
+    # σˣσʸ = iσᶻ on one site
+    n = 1
+    xy = expr_to_matrix_via_terms(n, "σˣ₀ σʸ₀", [[0]])
+    z = expr_to_matrix_via_terms(n, "σᶻ₀", [[0]])
+    np.testing.assert_allclose(xy, 1j * z, atol=1e-14)
+
+
+def test_heisenberg_bond_grouping():
+    """σˣσˣ+σʸσʸ share one flip mask: groups = 1 off-diag (2 legs) + 1 diag."""
+    expr = parse_expression("σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁")
+    terms = expr.instantiate([0, 1])
+    off = [t for t in terms if not t.is_diagonal]
+    diag = [t for t in terms if t.is_diagonal]
+    assert len(diag) == 1
+    xs = {t.x for t in off}
+    assert xs == {0b11}
+    assert len(off) == 2  # sign-mask-free and sign-masked legs
+
+
+def test_compose_is_operator_product(rng):
+    dim = 1 << 3
+    for _ in range(50):
+        t1 = NonbranchingTerm(
+            complex(rng.normal(), rng.normal()),
+            x=int(rng.integers(8)),
+            s=int(rng.integers(8)),
+            m=(m1 := int(rng.integers(8))),
+            r=int(rng.integers(8)) & m1,
+        )
+        t2 = NonbranchingTerm(
+            complex(rng.normal(), rng.normal()),
+            x=int(rng.integers(8)),
+            s=int(rng.integers(8)),
+            m=(m2 := int(rng.integers(8))),
+            r=int(rng.integers(8)) & m2,
+        )
+        prod = t1.compose(t2)
+        expected = term_matrix(3, t1) @ term_matrix(3, t2)
+        got = term_matrix(3, prod) if prod is not None else np.zeros((dim, dim))
+        np.testing.assert_allclose(got, expected, atol=1e-13)
+
+
+def test_dagger(rng):
+    for _ in range(30):
+        t = NonbranchingTerm(
+            complex(rng.normal(), rng.normal()),
+            x=int(rng.integers(8)),
+            s=int(rng.integers(8)),
+            m=(m := int(rng.integers(8))),
+            r=int(rng.integers(8)) & m,
+        )
+        np.testing.assert_allclose(
+            term_matrix(3, t.dagger()), term_matrix(3, t).conj().T, atol=1e-13
+        )
+
+
+def test_simplify_groups_and_drops_zeros():
+    a = NonbranchingTerm(1.0, x=1)
+    b = NonbranchingTerm(2.0, x=1)
+    c = NonbranchingTerm(-3.0, x=1)
+    assert simplify_terms([a, b, c]) == []
+    out = simplify_terms([a, b])
+    assert len(out) == 1 and out[0].v == 3.0
+
+
+def test_parenthesised_products_preserve_operator_order():
+    """Regression: (σˣ₀) σʸ₀ must equal σˣσʸ = iσᶻ, not σʸσˣ = −iσᶻ."""
+    n = 1
+    got = expr_to_matrix_via_terms(n, "(σˣ₀) σʸ₀", [[0]])
+    z = expr_to_matrix_via_terms(n, "σᶻ₀", [[0]])
+    np.testing.assert_allclose(got, 1j * z, atol=1e-14)
+    # and the distributed-sum case
+    got2 = expr_to_matrix_via_terms(2, "(σ⁺₀ + σ⁻₀) σᶻ₀", [[0]])
+    ref = expr_to_matrix_via_terms(2, "σ⁺₀ σᶻ₀ + σ⁻₀ σᶻ₀", [[0]])
+    np.testing.assert_allclose(got2, ref, atol=1e-14)
