@@ -20,6 +20,18 @@ Layers (bottom → top; compare SURVEY.md §1):
   solve/        — eigensolvers (Lanczos, LOBPCG) + drivers         (L6)
 """
 
+# Basis states are uint64 bitstrings and the accuracy contract is double
+# precision (atol 1e-14 / rtol 1e-12 — reference TestMatrixVectorProduct.chpl:15-16),
+# so 64-bit types are a hard requirement, enabled before any tracing happens.
+# (On TPU, XLA lowers u64/f64 to 32-bit pairs; the hot kernels are
+# integer/VPU-bound so the cost is acceptable — see SURVEY.md §7 hard part 4.)
+try:
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+except ImportError:  # pragma: no cover - jax is a hard dep in practice
+    pass
+
 from . import models, utils  # noqa: F401
 from .models.basis import SpinBasis, SpinfulFermionBasis, SpinlessFermionBasis
 from .models.operator import Operator

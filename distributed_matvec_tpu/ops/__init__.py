@@ -1,0 +1,3 @@
+"""Jitted device kernels: bit ops, operator application, orbit canonicalization."""
+
+from . import bits, kernels  # noqa: F401

@@ -1,0 +1,60 @@
+"""Device-side bit manipulation: popcount, shard hashing, state lookup.
+
+JAX/XLA equivalents of the reference's hot scalar kernels:
+  * ``hash64`` — splitmix64 finalizer (StatesEnumeration.chpl:122-127) used to
+    route each generated state to its owning shard,
+  * ``state_index_sorted`` — batched basis lookup replacing ``ls_hs_state_index``
+    (FFI.chpl:173-175) with a vectorized binary search over the *sorted* local
+    representative shard (shards are sorted by construction, so searchsorted is
+    exact),
+  * ``popcount64`` — sign-mask parity for the nonbranching term kernels.
+
+All functions are shape-polymorphic, jit-safe, and uint64-clean (require
+``jax_enable_x64``; on TPU XLA lowers 64-bit integer ops to u32 pairs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["popcount64", "hash64", "shard_index", "state_index_sorted", "sign_from_parity"]
+
+_U = jnp.uint64
+
+
+def popcount64(x: jax.Array) -> jax.Array:
+    return jax.lax.population_count(x.astype(jnp.uint64))
+
+
+def sign_from_parity(x: jax.Array) -> jax.Array:
+    """(−1)^popcount(x) as float (f64): +1 for even parity, −1 for odd."""
+    return 1.0 - 2.0 * (popcount64(x) & _U(1)).astype(jnp.float64)
+
+
+def hash64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer — bit-exact with enumeration.host.hash64."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> _U(30))) * _U(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> _U(27))) * _U(0x94D049BB133111EB)
+    return x ^ (x >> _U(31))
+
+
+def shard_index(states: jax.Array, n_shards: int) -> jax.Array:
+    """Owning device of each state (``localeIdxOf``, StatesEnumeration.chpl:129-136)."""
+    if n_shards == 1:
+        return jnp.zeros(states.shape, dtype=jnp.int32)
+    return (hash64(states) % _U(n_shards)).astype(jnp.int32)
+
+
+def state_index_sorted(sorted_reps: jax.Array, states: jax.Array):
+    """(index, found) of each state in a sorted representative array.
+
+    ``index`` is clipped into range; ``found`` marks exact hits.  The identity
+    fast path of the reference (DistributedMatrixVector.chpl:86-95) is
+    subsumed: XLA folds the search when the caller knows indices are trivial.
+    """
+    idx = jnp.searchsorted(sorted_reps, states)
+    idx = jnp.clip(idx, 0, sorted_reps.shape[0] - 1)
+    found = sorted_reps[idx] == states
+    return idx.astype(jnp.int64), found

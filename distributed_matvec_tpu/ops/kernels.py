@@ -1,0 +1,176 @@
+"""Jitted operator-application kernels: diag, off-diag, and state_info.
+
+These are the device replacements for the reference's three hot native kernels
+(all called from ``BatchedOperator.computeOffDiag``, /root/reference/src/BatchedOperator.chpl:82-213):
+
+  * ``ls_internal_operator_apply_diag_x1``      → :func:`apply_diag`
+  * ``ls_internal_operator_apply_off_diag_x1``  → :func:`apply_off_diag`
+  * ``ls_hs_state_info``                        → :func:`state_info`
+
+Design notes (TPU-first, SURVEY.md §7.3):
+  * The reference kernels *compact* their output through an offsets array —
+    a dynamic shape hostile to XLA.  Here the off-diag kernel emits a dense
+    ``[B, T]`` (T = flip-mask groups) with **zero amplitude** marking absent
+    elements; downstream routing multiplies by x and drops exact zeros.
+  * ``state_info`` canonicalizes through an orbit scan: a ``fori_loop`` over
+    the |G| group elements, each applied to the whole ``[M]`` batch via its
+    shift/mask network — no gathers, pure vector bit-ops on the VPU, O(G·S)
+    passes and O(M) memory (never materializes the [M, G] orbit).
+  * Everything is static-shape; chunking over row blocks happens in the engine.
+
+Tables are plain pytrees (NamedTuples of arrays) produced by
+:func:`device_tables` from a compiled :class:`~..models.operator.Operator`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bits import popcount64, sign_from_parity
+
+__all__ = [
+    "DiagKernelTables",
+    "OffDiagKernelTables",
+    "GroupTables",
+    "OperatorTables",
+    "device_tables",
+    "apply_diag",
+    "apply_off_diag",
+    "state_info",
+]
+
+_U = jnp.uint64
+
+
+class DiagKernelTables(NamedTuple):
+    v: jax.Array  # [K] f64 (real diagonal; Hermiticity enforced upstream)
+    s: jax.Array  # [K] u64
+    m: jax.Array  # [K] u64
+    r: jax.Array  # [K] u64
+
+
+class OffDiagKernelTables(NamedTuple):
+    x: jax.Array  # [T] u64 flip mask per group
+    v: jax.Array  # [T,K] f64 or c128 inner amplitudes (0 = padding)
+    s: jax.Array  # [T,K] u64
+    m: jax.Array  # [T,K] u64
+    r: jax.Array  # [T,K] u64
+
+
+class GroupTables(NamedTuple):
+    """Shift/mask networks + characters for the symmetry group (symmetry.py)."""
+
+    lshift: jax.Array     # [G,S] u64
+    rshift: jax.Array     # [G,S] u64
+    mask: jax.Array       # [G,S] u64
+    xor: jax.Array        # [G] u64  (spin-inversion elements)
+    char_conj: jax.Array  # [G] f64 or c128 — χ*(g), consumed multiplicatively
+    char_real: jax.Array  # [G] f64 — Re χ(g) for stabilizer norm sums
+
+
+class OperatorTables(NamedTuple):
+    diag: DiagKernelTables
+    off: OffDiagKernelTables
+    group: Optional[GroupTables]  # None when the basis needs no projection
+
+
+def device_tables(op) -> OperatorTables:
+    """Compile an :class:`Operator` into device-resident kernel tables."""
+    real = op.effective_is_real
+    amp_dtype = jnp.float64 if real else jnp.complex128
+    dt, ot = op.diag_table, op.off_diag_table
+    assert np.abs(dt.v.imag).max(initial=0.0) < 1e-12, "non-real diagonal"
+    diag = DiagKernelTables(
+        v=jnp.asarray(dt.v.real, jnp.float64),
+        s=jnp.asarray(dt.s),
+        m=jnp.asarray(dt.m),
+        r=jnp.asarray(dt.r),
+    )
+    if not real:
+        off_v = jnp.asarray(ot.v, jnp.complex128)
+    else:
+        assert np.abs(ot.v.imag).max(initial=0.0) < 1e-12
+        off_v = jnp.asarray(ot.v.real, jnp.float64)
+    off = OffDiagKernelTables(
+        x=jnp.asarray(ot.x), v=off_v, s=jnp.asarray(ot.s),
+        m=jnp.asarray(ot.m), r=jnp.asarray(ot.r),
+    )
+    group = None
+    if op.basis.requires_projection:
+        g = op.basis.group
+        ls, rs, ms, xor = g.shift_mask_tables()
+        cc = np.conj(g.characters)
+        group = GroupTables(
+            lshift=jnp.asarray(ls),
+            rshift=jnp.asarray(rs),
+            mask=jnp.asarray(ms),
+            xor=jnp.asarray(xor),
+            char_conj=jnp.asarray(cc.real if real else cc,
+                                  jnp.float64 if real else jnp.complex128),
+            char_real=jnp.asarray(g.characters.real, jnp.float64),
+        )
+    return OperatorTables(diag=diag, off=off, group=group)
+
+
+def apply_diag(t: DiagKernelTables, alphas: jax.Array) -> jax.Array:
+    """d(α) for a batch: [B] u64 → [B] f64."""
+    if t.v.shape[0] == 0:
+        return jnp.zeros(alphas.shape, jnp.float64)
+    a = alphas[:, None]
+    sign = sign_from_parity(a & t.s[None, :])
+    ok = (a & t.m[None, :]) == t.r[None, :]
+    return jnp.sum(t.v[None, :] * sign * ok, axis=1)
+
+
+def apply_off_diag(t: OffDiagKernelTables, alphas: jax.Array):
+    """H's off-diagonal action: [B] u64 → betas [B,T] u64, amps [B,T].
+
+    amps[i,j] = Σ_k v[j,k]·(−1)^pc(α_i∧s)·[α_i∧m==r]; betas[i,j] = α_i⊕x[j].
+    """
+    betas = alphas[:, None] ^ t.x[None, :]
+    a = alphas[:, None, None]
+    sign = sign_from_parity(a & t.s[None])
+    ok = (a & t.m[None]) == t.r[None]
+    amps = jnp.sum(t.v[None] * sign * ok, axis=2)
+    return betas, amps
+
+
+def state_info(g: GroupTables, states: jax.Array):
+    """Orbit scan: canonical representative, χ*, and norm for each state.
+
+    Contract of ``ls_hs_state_info`` (FFI.chpl:181-184) with the convention
+    validated against the dense projector path (tests/test_operator.py):
+      rep(σ)  = min_g g·σ
+      char(σ) = χ*(g_first-achieving-min)
+      norm(σ) = sqrt((1/|G|)·Σ_{g·σ=σ} Re χ(g))   (0 ⇒ not in the sector)
+    """
+    G = g.xor.shape[0]
+    flat = states.reshape(-1)
+
+    def apply_g(i, s):
+        acc = jnp.zeros_like(s)
+        S = g.mask.shape[1]
+        for k in range(S):  # S is tiny (≤ #distinct shift distances); unrolled
+            acc = acc | (((s & g.mask[i, k]) << g.lshift[i, k]) >> g.rshift[i, k])
+        return acc ^ g.xor[i]
+
+    def body(i, carry):
+        best, char, stab = carry
+        y = apply_g(i, flat)
+        better = y < best
+        best = jnp.where(better, y, best)
+        char = jnp.where(better, g.char_conj[i], char)
+        stab = stab + jnp.where(y == flat, g.char_real[i], 0.0)
+        return best, char, stab
+
+    init = (flat, jnp.full(flat.shape, g.char_conj[0]), jnp.zeros(flat.shape, jnp.float64))
+    # element 0 is the identity: best=flat, char=χ*(e)=1, stab starts at 0 and
+    # the loop re-adds the identity's contribution.
+    best, char, stab = jax.lax.fori_loop(0, G, body, init)
+    norm = jnp.sqrt(jnp.maximum(stab, 0.0) / G)
+    shape = states.shape
+    return best.reshape(shape), char.reshape(shape), norm.reshape(shape)

@@ -1,0 +1,3 @@
+"""Distribution layer: meshes, hashed sharding, the matvec engine, shuffles."""
+
+from . import engine  # noqa: F401

@@ -1,0 +1,151 @@
+"""The matvec engine: y = H·x over hash-sharded representative arrays.
+
+TPU-native redesign of ``/root/reference/src/DistributedMatrixVector.chpl``.
+The reference's ~900-line producer/consumer RDMA pipeline (radix partition by
+locale key, bounded remote buffers, fast-on flag handshakes, atomic
+accumulation) collapses into a bulk-synchronous collective pattern
+(SURVEY.md §7.4):
+
+    per shard:  off-diag kernel → state_info → bucket by hash(β) % D
+                → fixed-capacity all_to_all over ICI → searchsorted
+                → segment_sum scatter-add into the local y shard
+
+Single-device operation skips the exchange entirely (the analog of
+``localMatrixVector``, DistributedMatrixVector.chpl:1055-1070).
+
+Rows are processed in static-shape chunks via ``lax.scan`` (the analog of the
+reference's chunked producer loop, :879-883) so peak memory is
+O(B·T) regardless of basis size.
+
+Correctness guard: the reference halts on a generated state missing from the
+basis (:113-118).  Under jit we instead count such events and expose them;
+:class:`LocalEngine` checks the counter on the first application.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.operator import Operator
+from ..ops import kernels as K
+from ..ops.bits import state_index_sorted
+from ..utils.config import get_config
+
+__all__ = ["LocalEngine", "pad_to_multiple", "SENTINEL_STATE"]
+
+# Sentinel for padded representative slots: max u64 sorts after any real state
+# and never equals a generated β (states use ≤ 64 bits but amplitudes at the
+# sentinel are forced to zero by x-padding anyway).
+SENTINEL_STATE = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def pad_to_multiple(n: int, b: int) -> int:
+    return ((n + b - 1) // b) * b
+
+
+def _chunk_contribution(tables: K.OperatorTables, reps, norms, n_states,
+                        alphas, x_chunk, norms_chunk, real: bool):
+    """One row-block's off-diagonal scatter contributions (flattened)."""
+    betas, amps = K.apply_off_diag(tables.off, alphas)  # [B,T]
+    amps = amps * x_chunk[:, None]
+    if tables.group is not None:
+        rep_b, char_b, norm_b = K.state_info(tables.group, betas)
+        # rescale c ← c·χ*·n(β)/n(α)  (BatchedOperator.chpl:198-203)
+        amps = amps * char_b * (norm_b / norms_chunk[:, None])
+        betas = rep_b
+    flat_b = betas.reshape(-1)
+    flat_a = amps.reshape(-1)
+    idx, found = state_index_sorted(reps, flat_b)
+    nonzero = flat_a != 0
+    ok = nonzero & found
+    # a nonzero amplitude routed to a missing state is a hard error upstream
+    invalid = jnp.sum(nonzero & ~found)
+    return idx, jnp.where(ok, flat_a, 0), invalid
+
+
+class LocalEngine:
+    """Single-device jitted matvec over a built basis.
+
+    Usage::
+
+        eng = LocalEngine(operator)       # builds + uploads tables
+        y = eng.matvec(x)                 # jit-compiled, f64
+    """
+
+    def __init__(self, operator: Operator, batch_size: Optional[int] = None):
+        basis = operator.basis
+        if not basis.is_built:
+            basis.build()
+        cfg = get_config()
+        self.operator = operator
+        self.real = operator.effective_is_real
+        n = basis.number_states
+        b = min(batch_size or cfg.matvec_batch_size, max(n, 1))
+        n_pad = pad_to_multiple(n, b)
+        self.n_states = n
+        self.batch_size = b
+        self.num_chunks = n_pad // b
+
+        reps = basis.representatives
+        norms = basis.norms
+        self._reps = jnp.asarray(reps)  # [N] sorted, unpadded (search target)
+        pad = n_pad - n
+        self._alphas = jnp.asarray(
+            np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
+        ).reshape(self.num_chunks, b)
+        self._norms = jnp.asarray(
+            np.concatenate([norms, np.ones(pad)])
+        ).reshape(self.num_chunks, b)
+        self.tables = K.device_tables(operator)
+        self._dtype = jnp.float64 if self.real else jnp.complex128
+        self._checked = False
+
+        @jax.jit
+        def _matvec(x):
+            x = x.astype(self._dtype)
+            xp = jnp.pad(x, (0, pad)).reshape(self.num_chunks, b)
+            # Diagonal part (localDiagonal, DistributedMatrixVector.chpl:36-71)
+            diag = K.apply_diag(self.tables.diag, self._alphas.reshape(-1))[: n]
+            y0 = diag.astype(self._dtype) * x
+
+            def step(carry, inputs):
+                y, bad = carry
+                alphas, xc, nc = inputs
+                idx, amps, invalid = _chunk_contribution(
+                    self.tables, self._reps, self._norms, n, alphas, xc, nc,
+                    self.real,
+                )
+                y = y + jax.ops.segment_sum(amps, idx, num_segments=n)
+                return (y, bad + invalid), None
+
+            (y, bad), _ = jax.lax.scan(
+                step,
+                (y0, jnp.zeros((), jnp.int64)),
+                (self._alphas, xp, self._norms),
+            )
+            return y, bad
+
+        self._matvec = _matvec
+
+    def matvec(self, x, check: Optional[bool] = None) -> jax.Array:
+        """y = H·x.  On the first call (or with ``check=True``) verifies that
+        no nonzero amplitude was routed to a state outside the basis — the
+        engine-level halt of the reference (DistributedMatrixVector.chpl:113-118)."""
+        y, bad = self._matvec(jnp.asarray(x))
+        if check or (check is None and not self._checked):
+            if int(bad) != 0:
+                raise RuntimeError(
+                    f"{int(bad)} generated amplitudes map outside the basis — "
+                    "operator does not preserve the chosen sector"
+                )
+            self._checked = True
+        return y
+
+    def __call__(self, x):
+        return self.matvec(x)
