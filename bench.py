@@ -1,0 +1,127 @@
+"""Benchmark driver: H·x wall-clock on the chip vs the single-node CPU path.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...extras}
+
+``vs_baseline`` is the speedup over the single-node CPU wall-clock measured
+in-process (the NumPy host matvec — the same "beat single-node CPU" contract
+as BASELINE.json's north star).  Extra keys carry per-config detail.
+
+Usage: ``python bench.py`` (full, runs on the default JAX backend — the TPU
+chip under the driver); ``python bench.py --smoke`` (small config, CPU-safe).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench_config(name, basis_args, edges_fn, repeats=20, host_repeats=3,
+                  solver_iters=0):
+    import jax
+
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import heisenberg_from_edges
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    t0 = time.perf_counter()
+    basis = SpinBasis(**basis_args)
+    op = heisenberg_from_edges(basis, edges_fn(basis.number_spins))
+    basis.build()
+    build_s = time.perf_counter() - t0
+    n = basis.number_states
+
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal(n)
+    x /= np.linalg.norm(x)
+
+    t0 = time.perf_counter()
+    eng = LocalEngine(op, mode="ell")
+    init_s = time.perf_counter() - t0
+
+    xj = jax.numpy.asarray(x)
+    y = jax.block_until_ready(eng._matvec(xj)[0])  # compile
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        y = eng._matvec(xj)[0]
+    jax.block_until_ready(y)
+    device_ms = (time.perf_counter() - t0) / repeats * 1e3
+
+    t0 = time.perf_counter()
+    for _ in range(host_repeats):
+        y_host = op.matvec_host(x)
+    host_ms = (time.perf_counter() - t0) / host_repeats * 1e3
+
+    err = float(np.max(np.abs(np.asarray(y) - y_host)))
+
+    out = {
+        "config": name,
+        "n_states": n,
+        "basis_build_s": round(build_s, 3),
+        "engine_init_s": round(init_s, 3),
+        "device_ms": round(device_ms, 3),
+        "host_numpy_ms": round(host_ms, 3),
+        "speedup_vs_numpy": round(host_ms / device_ms, 2),
+        "max_err_vs_host": err,
+    }
+
+    if solver_iters:
+        from distributed_matvec_tpu.solve.lanczos import lanczos
+
+        t0 = time.perf_counter()
+        res = lanczos(eng.matvec, n, k=1, max_iters=solver_iters, seed=42)
+        dt = time.perf_counter() - t0
+        out["lanczos_iters_per_s"] = round(res.num_iters / dt, 2)
+        out["lanczos_e0"] = float(res.eigenvalues[0])
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small CPU-safe run")
+    args = ap.parse_args()
+
+    try:
+        from distributed_matvec_tpu.models.lattices import chain_edges
+    except Exception as e:  # pragma: no cover
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0, "error": str(e)}))
+        return 1
+
+    def chain(n):
+        return chain_edges(n)
+
+    if args.smoke:
+        main_cfg = _bench_config(
+            "heisenberg_chain_16", dict(number_spins=16, hamming_weight=8),
+            chain, repeats=5, host_repeats=1, solver_iters=20)
+        extras = {}
+    else:
+        main_cfg = _bench_config(
+            "heisenberg_chain_20", dict(number_spins=20, hamming_weight=10),
+            chain, solver_iters=50)
+        extras = {
+            "chain_24_symm": _bench_config(
+                "heisenberg_chain_24_symm",
+                dict(number_spins=24, hamming_weight=12, spin_inversion=1,
+                     symmetries=[([*range(1, 24), 0], 0),
+                                 ([*reversed(range(24))], 0)]),
+                chain, repeats=20, host_repeats=1),
+        }
+
+    line = {
+        "metric": "Hx_wallclock_ms",
+        "value": main_cfg["device_ms"],
+        "unit": "ms",
+        "vs_baseline": main_cfg["speedup_vs_numpy"],
+        "detail": {"main": main_cfg, **extras},
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

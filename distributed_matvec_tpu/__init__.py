@@ -5,14 +5,14 @@ A from-scratch JAX/XLA re-design with the capabilities of
 ``twesterhout/distributed-matvec`` (Chapel + GASNet + Haskell kernels +
 PRIMME): symmetry-reduced basis enumeration, hash-sharded state distribution
 over a ``jax.sharding.Mesh``, matrix-free ``y = H·x`` with on-device operator
-application and ICI ``all_to_all`` amplitude routing, layout shuffles, HDF5
-golden/checkpoint I/O, and iterative eigensolvers.
+application and ICI ``all_to_all`` amplitude routing, layout shuffles, and
+iterative eigensolvers (Lanczos/LOBPCG).
 
 Layers (bottom → top; compare SURVEY.md §1):
   utils/        — config flags, logging, tree timers               (L-cross)
   models/       — expressions → nonbranching terms, symmetry groups,
                   bases, operators, YAML configs, lattice builders (L2)
-  enumeration/  — representative enumeration: NumPy + native C++   (L4)
+  enumeration/  — representative enumeration (host)                (L4)
   ops/          — jitted device kernels (diag/off-diag apply,
                   state_info orbit scans, searchsorted indexing)   (L5)
   parallel/     — mesh/sharding, all_to_all matvec engine,

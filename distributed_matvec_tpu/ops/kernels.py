@@ -40,6 +40,8 @@ __all__ = [
     "device_tables",
     "apply_diag",
     "apply_off_diag",
+    "gather_coefficients",
+    "mask_structure",
     "state_info",
 ]
 
@@ -139,6 +141,48 @@ def apply_off_diag(t: OffDiagKernelTables, alphas: jax.Array):
     return betas, amps
 
 
+def gather_coefficients(t: OperatorTables, alphas: jax.Array,
+                        norms_alpha: jax.Array):
+    """Row-form (gather) neighbor structure of a Hermitian operator.
+
+    For each row state ``α`` returns the canonical target states and the
+    *row* matrix elements ``A[α, rep(β)] = conj(⟨β|H|α⟩·χ*(g))·n(β)/n(α)``
+    (valid because H_eff is Hermitian: A_ij = conj(A_ji); the scatter-form
+    rescale is BatchedOperator.chpl:198-203).  Shapes: [B] u64 → ([B,T] u64,
+    [B,T] amp).  Zero amplitude marks "no matrix element" (padding included).
+    """
+    betas, amps = apply_off_diag(t.off, alphas)  # amps = ⟨β|H|α⟩
+    if t.group is not None:
+        rep_b, char_conj_b, norm_b = state_info(t.group, betas)
+        amps = jnp.conj(amps * char_conj_b) * (norm_b / norms_alpha[:, None])
+        betas = rep_b
+    else:
+        amps = jnp.conj(amps)
+    return betas, amps
+
+
+def mask_structure(coeff: jax.Array, idx: jax.Array, found: jax.Array,
+                   valid_row: jax.Array):
+    """Shared post-kernel masking: zero out absent/padded entries and count
+    out-of-basis targets.
+
+    ``valid_row`` marks non-SENTINEL rows ([B] bool).  Returns
+    (idx, coeff, invalid) where entries with a *structurally* nonzero
+    coefficient targeting a state not found in the basis are counted as
+    ``invalid`` (the halt condition of DistributedMatrixVector.chpl:113-118).
+    Counting structure (coeff ≠ 0) rather than amplitude·x keeps the result
+    independent of x's zero pattern, so a first-call check is valid for every
+    subsequent application.
+    """
+    vr = valid_row[:, None]
+    nz = (coeff != 0) & vr
+    invalid = jnp.sum(nz & ~found)
+    nz = nz & found
+    coeff = jnp.where(nz, coeff, 0)
+    idx = jnp.where(nz, idx, 0)
+    return idx, coeff, invalid
+
+
 def state_info(g: GroupTables, states: jax.Array):
     """Orbit scan: canonical representative, χ*, and norm for each state.
 
@@ -167,7 +211,10 @@ def state_info(g: GroupTables, states: jax.Array):
         stab = stab + jnp.where(y == flat, g.char_real[i], 0.0)
         return best, char, stab
 
-    init = (flat, jnp.full(flat.shape, g.char_conj[0]), jnp.zeros(flat.shape, jnp.float64))
+    # Zero with the same device-varying type as the input (so the carry is
+    # stable when this runs inside shard_map; XLA folds the xor away).
+    zero = (flat ^ flat).astype(jnp.float64)
+    init = (flat, g.char_conj[0] + zero.astype(g.char_conj.dtype), zero)
     # element 0 is the identity: best=flat, char=χ*(e)=1, stab starts at 0 and
     # the loop re-adds the identity's contribution.
     best, char, stab = jax.lax.fori_loop(0, G, body, init)

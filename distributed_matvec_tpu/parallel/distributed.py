@@ -1,0 +1,479 @@
+"""Multi-device matvec engine: y = H·x over hash-sharded shards of the basis.
+
+TPU-native redesign of the reference's distributed engine
+(``/root/reference/src/DistributedMatrixVector.chpl``): ``matrixVectorProduct``
+(:1072-1093) runs per-locale SPMD producers that generate ``(β, c·x[α])``
+amplitudes, radix-partition them by owning locale (:265-311), push them
+through bounded RDMA buffers (:313-436) and accumulate on the owner with
+atomics — ~900 lines of hand-rolled flow control.  Here the Hilbert dimension
+is sharded over a 1-D ``jax.sharding.Mesh`` (state σ lives on shard
+``hash64(σ) % D``, exactly ``localeIdxOf``, StatesEnumeration.chpl:129-136)
+and the exchange is a single XLA ``all_to_all`` over ICI inside ``shard_map``.
+
+Two modes, mirroring :class:`~.engine.LocalEngine`:
+
+* ``"ell"`` (default) — **static routing plan**.  Because the sparsity
+  structure is fixed per (operator, basis), the cross-shard communication
+  schedule can be *precompiled*: at build time each shard computes, for every
+  local row, which (peer, local-index) each neighbor amplitude lives at; the
+  per-peer query lists are exchanged once on the host.  Every subsequent
+  matvec is then
+
+      send buffer  S[q] = x_local[queries_from_q]     (static gather)
+      R = all_to_all(S)                               (one collective, pure x values)
+      y = diag·x + Σ_t coeff[t] · concat(x_local, R)[g_idx[t]]
+
+  — no u64 hashing, no sort, no searchsorted, no scatter at matvec time.
+  This replaces the reference's *dynamic* producer/consumer routing with a
+  compile-time communication plan, the way XLA itself handles sharded matmuls.
+
+* ``"fused"`` — dynamic bucketing for bases whose ELL tables exceed HBM: per
+  row chunk, generate amplitudes (scatter form), sort by owner, compact into
+  fixed-capacity ``[D, C]`` buffers (capacity from ``remote_buffer_size`` ×
+  ``all_to_all_capacity_factor`` — the analog of ``kRemoteBufferSize``,
+  DistributedMatrixVector.chpl:456), ``all_to_all``, then
+  ``searchsorted`` + ``segment_sum`` on the owner.  Overflowed contributions
+  are *counted* and surfaced (the reference instead blocks on a full buffer);
+  the first apply checks the counter and fails loudly.
+
+Both modes keep the reference's invariant check: a nonzero amplitude routed
+to a state absent from the basis raises (DistributedMatrixVector.chpl:113-118).
+
+Layouts: ``x`` and ``y`` live in *hashed* layout ``[D, M]`` (shard-padded,
+pad slots zero); :class:`~.shuffle.HashedLayout` converts to/from the global
+sorted (*block*) order.  Batches ``[D, M, k]`` are supported end-to-end.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.operator import Operator
+from ..ops import kernels as K
+from ..ops.bits import hash64, state_index_sorted
+from ..utils.config import get_config
+from ..utils.logging import log_debug
+from ..utils.timers import TreeTimer
+from .engine import SENTINEL_STATE
+from .mesh import SHARD_AXIS, make_mesh, shard_spec
+from .shuffle import HashedLayout
+
+__all__ = ["DistributedEngine"]
+
+
+def _round_up(n: int, b: int) -> int:
+    return max(((n + b - 1) // b) * b, b)
+
+
+class DistributedEngine:
+    """Hash-sharded distributed matvec over a ``jax.sharding.Mesh``.
+
+    Usage::
+
+        eng = DistributedEngine(operator, n_devices=8)
+        xh = eng.to_hashed(x)          # block [N] → hashed [D, M]
+        yh = eng.matvec(xh)            # one all_to_all per application
+        y = eng.from_hashed(yh)
+
+    Semantics match ``matrixVectorProduct``
+    (DistributedMatrixVector.chpl:1072-1093); distribution matches
+    ``localeIdxOf`` hashing (StatesEnumeration.chpl:129-136).
+    """
+
+    def __init__(self, operator: Operator, mesh: Optional[Mesh] = None,
+                 n_devices: Optional[int] = None,
+                 batch_size: Optional[int] = None,
+                 mode: Optional[str] = None):
+        basis = operator.basis
+        if not basis.is_built:
+            basis.build()
+        cfg = get_config()
+        mode = mode or cfg.matvec_mode
+        if mode not in ("ell", "fused"):
+            raise ValueError(f"unknown engine mode {mode!r}")
+        if not operator.is_hermitian:
+            raise ValueError("the engine requires a Hermitian operator")
+        self.operator = operator
+        self.mode = mode
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.n_devices = self.mesh.devices.size
+        self.real = operator.effective_is_real
+        self._dtype = jnp.float64 if self.real else jnp.complex128
+        self.timer = TreeTimer("DistributedEngine")
+
+        reps, norms = basis.representatives, basis.norms
+        D = self.n_devices
+        self.layout = HashedLayout(reps, D)
+        M = self.layout.shard_size
+        self.n_states = reps.size
+        self.shard_size = M
+
+        # Per-shard sorted representative/norm arrays [D, M] (SENTINEL pad).
+        alphas = self.layout.to_hashed(reps, fill=SENTINEL_STATE)
+        nrm = self.layout.to_hashed(norms, fill=1.0)
+        self.tables = K.device_tables(operator)
+        self.num_terms = int(self.tables.off.x.shape[0])
+
+        self._sh1 = shard_spec(self.mesh, 2)
+        self._sh2 = shard_spec(self.mesh, 3)
+        put = partial(jax.device_put, device=self._sh1)
+        self._alphas = put(jnp.asarray(alphas))
+        self._norms = put(jnp.asarray(nrm))
+        dd = np.asarray(jax.jit(
+            lambda s: K.apply_diag(self.tables.diag, s)
+        )(jnp.asarray(alphas.reshape(-1)))).reshape(D, M)
+        self._diag = put(jnp.asarray(
+            np.where(alphas != SENTINEL_STATE, dd, 0.0)))
+
+        b = min(batch_size or cfg.matvec_batch_size, M)
+        self.batch_size = _round_up(min(b, M), 8)
+        self._checked = False
+
+        if mode == "ell":
+            with self.timer.scope("build_plan"):
+                self._build_plan(alphas, nrm)
+            self._matvec = self._make_ell_matvec()
+            self._checked = True
+        else:
+            self._capacity = self._fused_capacity()
+            self._matvec = self._make_fused_matvec()
+
+    # ------------------------------------------------------------------
+    # ELL mode: static routing plan
+    # ------------------------------------------------------------------
+
+    def _build_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray) -> None:
+        """Compute per-shard neighbor structure + the cross-shard query plan.
+
+        Replaces the reference's per-matvec radix partition + buffer routing
+        (DistributedMatrixVector.chpl:265-311, :559-735) with a one-time
+        host-coordinated exchange of *static* query lists.
+        """
+        D, M, T = self.n_devices, self.shard_size, self.num_terms
+        reps_all = jnp.asarray(alphas_h)  # [D, M] replicated during build
+
+        @jax.jit
+        def build_shard(alphas, norms_a):
+            betas, coeff = K.gather_coefficients(self.tables, alphas, norms_a)
+            owner = (hash64(betas) % jnp.uint64(D)).astype(jnp.int32) \
+                if D > 1 else jnp.zeros(betas.shape, jnp.int32)
+            idx = jnp.zeros(betas.shape, jnp.int32)
+            found = jnp.zeros(betas.shape, bool)
+            for p in range(D):
+                ip, fp = state_index_sorted(reps_all[p], betas.reshape(-1))
+                ip = ip.reshape(betas.shape).astype(jnp.int32)
+                fp = fp.reshape(betas.shape)
+                sel = owner == p
+                idx = jnp.where(sel, ip, idx)
+                found = jnp.where(sel, fp, found)
+            idx, coeff, invalid = K.mask_structure(
+                coeff, idx, found, alphas != SENTINEL_STATE)
+            owner = jnp.where(coeff != 0, owner, -1)
+            return owner, idx, coeff, invalid
+
+        owners = np.empty((D, M, T), np.int32)
+        idxs = np.empty((D, M, T), np.int32)
+        coeffs = np.empty((D, M, T),
+                          np.float64 if self.real else np.complex128)
+        bad = 0
+        for d in range(D):
+            o, i, c, inv = build_shard(jnp.asarray(alphas_h[d]),
+                                       jnp.asarray(norms_h[d]))
+            owners[d], idxs[d], coeffs[d] = np.asarray(o), np.asarray(i), np.asarray(c)
+            bad += int(inv)
+        if bad:
+            raise RuntimeError(
+                f"{bad} generated matrix elements map outside the basis — "
+                "operator does not preserve the chosen sector"
+            )
+
+        # Host: per-(d, p) query lists Q[d][p] = local indices on p that d
+        # reads, in row-major (m, t) order.
+        queries = [[None] * D for _ in range(D)]
+        for d in range(D):
+            od, id_ = owners[d].reshape(-1), idxs[d].reshape(-1)
+            for p in range(D):
+                if p == d:
+                    continue
+                queries[d][p] = id_[od == p]
+        cap = max((q.size for row in queries for q in row if q is not None),
+                  default=0)
+        C = _round_up(cap, 8)
+        self.query_capacity = C
+        remote_total = sum(q.size for row in queries for q in row if q is not None)
+        log_debug(f"routing plan: D={D} M={M} T={T} capacity={C} "
+                  f"remote_elements={remote_total}")
+
+        # g_idx: per entry, position in concat(x_local [M], R.flat [D*C]).
+        g_idx = np.zeros((D, M, T), np.int32)
+        for d in range(D):
+            od = owners[d].reshape(-1)
+            id_ = idxs[d].reshape(-1)
+            gi = np.zeros(od.shape, np.int64)
+            local = od == d
+            gi[local] = id_[local]
+            for p in range(D):
+                if p == d:
+                    continue
+                sel = od == p
+                k = np.arange(sel.sum())
+                gi[sel] = M + p * C + k
+            g_idx[d] = gi.reshape(M, T)
+
+        # qin[d][q] = Q[q][d] — what peer q asked this shard for (0-padded).
+        qin = np.zeros((D, D, C), np.int32)
+        for d in range(D):
+            for q in range(D):
+                if q == d or queries[q][d] is None:
+                    continue
+                qq = queries[q][d]
+                qin[d, q, : qq.size] = qq
+
+        sh3 = shard_spec(self.mesh, 3)
+        # Transposed [T, M] per shard (see LocalEngine layout note).
+        self._ell_idx = jax.device_put(
+            jnp.asarray(np.swapaxes(g_idx, 1, 2)), sh3)
+        self._ell_coeff = jax.device_put(
+            jnp.asarray(np.swapaxes(coeffs, 1, 2)), sh3)
+        self._qin = jax.device_put(jnp.asarray(qin), sh3)
+
+    def _make_ell_matvec(self):
+        D, M, T, C = (self.n_devices, self.shard_size, self.num_terms,
+                      self.query_capacity)
+        dtype = self._dtype
+
+        def shard_body(x, qin, gidx, coeff, diag):
+            x, qin, gidx, coeff, diag = (a[0] for a in (x, qin, gidx, coeff, diag))
+            batched = x.ndim == 2
+            if D > 1:
+                S = x[qin]                      # [D, C(, k)]
+                R = jax.lax.all_to_all(S, SHARD_AXIS, 0, 0, tiled=True)
+                xx = jnp.concatenate(
+                    [x, R.reshape((D * C,) + x.shape[1:])], axis=0)
+            else:
+                xx = x
+            y = (diag[:, None] if batched else diag).astype(dtype) * x
+            for t in range(T):
+                c = coeff[t]
+                y = y + (c[:, None] if batched else c) * xx[gidx[t]]
+            return y[None]
+
+        spec1 = P(SHARD_AXIS, None)
+        spec2 = P(SHARD_AXIS, None, None)
+        spec3 = P(SHARD_AXIS, None, None)
+
+        @partial(jax.jit, static_argnames=("batched",))
+        def _mv(x, qin, gidx, coeff, diag, batched):
+            xspec = spec2 if batched else spec1
+            f = jax.shard_map(
+                shard_body, mesh=self.mesh,
+                in_specs=(xspec, spec3, spec3, spec3, spec1),
+                out_specs=xspec,
+            )
+            return f(x.astype(dtype), qin, gidx, coeff, diag)
+
+        def run(x):
+            return (_mv(x, self._qin, self._ell_idx, self._ell_coeff,
+                        self._diag, batched=(x.ndim == 3)),
+                    jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64))
+
+        return run
+
+    # ------------------------------------------------------------------
+    # Fused mode: dynamic bucketing + all_to_all + segment_sum
+    # ------------------------------------------------------------------
+
+    def _fused_capacity(self) -> int:
+        cfg = get_config()
+        D, T, B = self.n_devices, self.num_terms, self.batch_size
+        total = B * max(T, 1)
+        if D == 1:
+            return _round_up(total, 8)
+        mean = total / D
+        cap = int(math.ceil(mean * max(cfg.all_to_all_capacity_factor, 1.0)))
+        cap = min(max(cap, 64), total, cfg.remote_buffer_size)
+        return _round_up(cap, 8)
+
+    def _make_fused_matvec(self):
+        D, M, T = self.n_devices, self.shard_size, self.num_terms
+        B = self.batch_size
+        Cap = self._capacity
+        nchunks = M // B if M % B == 0 else M // B + 1
+        Mp = nchunks * B
+        dtype = self._dtype
+        tables = self.tables
+
+        def shard_body(x, alphas, norms):
+            x, alphas, norms = x[0], alphas[0], norms[0]
+            # pad local arrays to a whole number of chunks
+            xp = jnp.pad(x, (0, Mp - M))
+            ap = jnp.pad(alphas, (0, Mp - M),
+                         constant_values=SENTINEL_STATE)
+            np_ = jnp.pad(norms, (0, Mp - M), constant_values=1.0)
+
+            def chunk(carry, args):
+                y, overflow, invalid = carry
+                a_c, n_c, x_c = args
+                betas, gcoeff = K.gather_coefficients(tables, a_c, n_c)
+                # scatter-form amplitude: conj(row form) · x[α].  Liveness is
+                # *structural* (coeff ≠ 0, row not padding) — independent of
+                # x's zero pattern, so the overflow/invalid counters checked
+                # on the first call hold for every later x.
+                valid_row = (a_c != SENTINEL_STATE)[:, None]
+                nz = (gcoeff != 0) & valid_row
+                amps = jnp.where(nz, jnp.conj(gcoeff) * x_c[:, None], 0)
+                flat_b = betas.reshape(-1)
+                flat_a = amps.reshape(-1)
+                live = nz.reshape(-1)
+                owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
+                    if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
+                key = jnp.where(live, owner, D)
+                order = jnp.argsort(key, stable=True)
+                key_s = key[order]
+                b_s = flat_b[order]
+                a_s = flat_a[order]
+                starts = jnp.searchsorted(key_s, jnp.arange(D + 1))
+                pos = jnp.arange(key_s.shape[0]) - starts[jnp.clip(key_s, 0, D)]
+                in_cap = (pos < Cap) & (key_s < D)
+                overflow = overflow + jnp.sum((pos >= Cap) & (key_s < D))
+                dest = jnp.where(in_cap, key_s * Cap + pos, D * Cap)
+                send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
+                    b_s, mode="drop")
+                send_a = jnp.zeros(D * Cap, dtype).at[dest].set(
+                    a_s, mode="drop")
+                if D > 1:
+                    recv_b = jax.lax.all_to_all(
+                        send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
+                    ).reshape(-1)
+                    recv_a = jax.lax.all_to_all(
+                        send_a.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
+                    ).reshape(-1)
+                else:
+                    recv_b, recv_a = send_b, send_a
+                idx, found = state_index_sorted(alphas, recv_b)
+                # structural liveness on the receive side: real entries carry
+                # a non-SENTINEL state (padding slots are SENTINEL, amp 0)
+                live_r = recv_b != SENTINEL_STATE
+                okc = found & live_r
+                invalid = invalid + jnp.sum(live_r & ~found)
+                y = y + jax.ops.segment_sum(
+                    jnp.where(okc, recv_a, 0), jnp.where(okc, idx, 0),
+                    num_segments=M)
+                return (y, overflow, invalid), None
+
+            init = jax.lax.pvary(
+                (jnp.zeros(M, dtype), jnp.zeros((), jnp.int64),
+                 jnp.zeros((), jnp.int64)),
+                SHARD_AXIS,
+            )
+            (y, overflow, invalid), _ = jax.lax.scan(
+                chunk, init,
+                (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
+                 xp.reshape(nchunks, B).astype(dtype)),
+            )
+            # cross-shard totals so every shard reports the same counters
+            overflow = jax.lax.psum(overflow, SHARD_AXIS)
+            invalid = jax.lax.psum(invalid, SHARD_AXIS)
+            return y[None], overflow[None], invalid[None]
+
+        spec1 = P(SHARD_AXIS, None)
+        specs = P(SHARD_AXIS)
+
+        @jax.jit
+        def _mv(x, alphas, norms, diag):
+            f = jax.shard_map(
+                shard_body, mesh=self.mesh,
+                in_specs=(spec1, spec1, spec1),
+                out_specs=(spec1, specs, specs),
+            )
+            y, overflow, invalid = f(x.astype(dtype), alphas, norms)
+            y = y + diag.astype(dtype) * x.astype(dtype)
+            return y, overflow[0], invalid[0]
+
+        def run(x):
+            if x.ndim == 3:
+                # batch: apply per column (fused mode favors memory over speed)
+                cols = [
+                    _mv(x[..., k], self._alphas, self._norms, self._diag)
+                    for k in range(x.shape[-1])
+                ]
+                y = jnp.stack([c[0] for c in cols], axis=-1)
+                overflow = sum(c[1] for c in cols)
+                invalid = sum(c[2] for c in cols)
+                return y, overflow, invalid
+            return _mv(x, self._alphas, self._norms, self._diag)
+
+        return run
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    def to_hashed(self, x) -> jax.Array:
+        """Block (global sorted) → hashed layout, device-sharded."""
+        xh = self.layout.to_hashed(np.asarray(x), fill=0)
+        sh = self._sh1 if xh.ndim == 2 else self._sh2
+        return jax.device_put(jnp.asarray(xh), sh)
+
+    def from_hashed(self, xh) -> np.ndarray:
+        return self.layout.from_hashed(np.asarray(xh))
+
+    def random_hashed(self, seed: int = 0):
+        """A normalized random vector directly in hashed layout (pads zero)."""
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal(self.n_states)
+        x /= np.linalg.norm(x)
+        return self.to_hashed(x)
+
+    def matvec(self, xh, check: Optional[bool] = None) -> jax.Array:
+        """y = H·x in hashed layout ([D, M] or [D, M, k]).
+
+        First call (or ``check=True``) validates the overflow and
+        invalid-state counters — the loud-failure analogs of the reference's
+        blocking buffers and halt (DistributedMatrixVector.chpl:113-118).
+        """
+        with self.timer.scope("matvec"):
+            xh = jnp.asarray(xh)
+            y, overflow, invalid = self._matvec(xh)
+            if check or (check is None and not self._checked):
+                if int(overflow):
+                    raise RuntimeError(
+                        f"{int(overflow)} amplitudes overflowed the all_to_all "
+                        f"capacity {self._capacity}; raise remote_buffer_size "
+                        "or all_to_all_capacity_factor"
+                    )
+                if int(invalid):
+                    raise RuntimeError(
+                        f"{int(invalid)} generated amplitudes map outside the "
+                        "basis — operator does not preserve the chosen sector"
+                    )
+                self._checked = True
+        return y
+
+    def matvec_global(self, x) -> np.ndarray:
+        """Convenience: block-layout in/out (shuffle → matvec → unshuffle)."""
+        return self.from_hashed(self.matvec(self.to_hashed(x)))
+
+    def dot(self, ah, bh) -> jax.Array:
+        """Global ⟨a, b⟩ over hashed vectors (pad slots are zero by invariant).
+        The engine-side analog of PRIMME's ``globalSumReal``
+        (PRIMME.chpl:267-311) — XLA turns the sum over the sharded axis into
+        a psum over ICI."""
+        return jnp.vdot(jnp.asarray(ah), jnp.asarray(bh))
+
+    def __call__(self, xh):
+        return self.matvec(xh)
+
+    @property
+    def ell_nbytes(self) -> int:
+        if self.mode != "ell":
+            return 0
+        return (self._ell_idx.nbytes + self._ell_coeff.nbytes
+                + self._qin.nbytes)

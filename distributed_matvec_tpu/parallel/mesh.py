@@ -1,0 +1,40 @@
+"""Device-mesh helpers for the hash-sharded engine.
+
+The reference runs one Chapel locale per node over GASNet
+(``env/setup-env.sh``); devices here are TPU chips in a 1-D
+``jax.sharding.Mesh`` whose single axis shards the Hilbert dimension.
+Multi-host extension: initialise ``jax.distributed`` first, then build the
+mesh over ``jax.devices()`` — the collectives ride ICI within a slice and
+DCN across hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["SHARD_AXIS", "make_mesh", "shard_spec"]
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over ``n_devices`` (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shard_spec(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Sharding that splits axis 0 over the mesh, replicating the rest."""
+    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS, *([None] * (ndim - 1))))

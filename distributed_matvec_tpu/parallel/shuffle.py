@@ -1,0 +1,95 @@
+"""Block ↔ hashed layout conversion.
+
+The reference maintains two layouts for every distributed array
+(SURVEY.md §1): *block* — contiguous split of the globally sorted index space
+(I/O order, ``MyHDF5.chpl:272-286``) — and *hashed* — state σ lives on locale
+``hash64(σ) % D`` (compute order, ``StatesEnumeration.chpl:122-136``).  Its
+converters ``arrFromBlockToHashed`` / ``arrFromHashedToBlock``
+(``BlockToHashed.chpl:87``, ``HashedToBlock.chpl:67``) are ~370 lines of
+counted PUT machinery.
+
+Here a layout is a precomputed permutation: ``perm[d, j]`` = global (block)
+index of the j-th element of shard d, padded with −1.  Conversion is then a
+single gather, which XLA lowers to the same counted all-to-all when the
+operands are device-sharded — the entire module replaces the reference's
+count-matrix/offsets/PUT pipeline.
+
+Rank-2 batches (the reference's ``batchStride`` loops, BlockToHashed.chpl:111-117)
+fall out of the same gather with a trailing axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..enumeration.host import shard_index
+
+__all__ = ["HashedLayout"]
+
+
+class HashedLayout:
+    """Hash-shard layout descriptor for a sorted global state array.
+
+    ``counts[d]`` — number of real elements on shard d;
+    ``perm[d, j]`` — block-layout index held at hashed position (d, j), −1 pad;
+    ``inverse[i]`` — (d, j) flattened position of block index i.
+    """
+
+    def __init__(self, states: np.ndarray, n_shards: int,
+                 pad_multiple: int = 128):
+        states = np.asarray(states, dtype=np.uint64)
+        n = states.size
+        owner = shard_index(states, n_shards)
+        counts = np.bincount(owner, minlength=n_shards).astype(np.int64)
+        m = int(counts.max(initial=0))
+        m = max(((m + pad_multiple - 1) // pad_multiple) * pad_multiple,
+                pad_multiple)
+        perm = np.full((n_shards, m), -1, dtype=np.int64)
+        for d in range(n_shards):
+            idx = np.flatnonzero(owner == d)
+            perm[d, : idx.size] = idx
+        self.n_global = n
+        self.n_shards = n_shards
+        self.shard_size = m
+        self.counts = counts
+        self.perm = perm
+        flat = perm.reshape(-1)
+        real = flat >= 0
+        inverse = np.empty(n, dtype=np.int64)
+        inverse[flat[real]] = np.flatnonzero(real)
+        self.inverse = inverse
+
+    # -- host (NumPy) --------------------------------------------------------
+
+    def to_hashed(self, arr: np.ndarray, fill=0) -> np.ndarray:
+        """Block → hashed (``arrFromBlockToHashed``): [N, ...] → [D, M, ...]."""
+        arr = np.asarray(arr)
+        out_shape = (self.n_shards, self.shard_size) + arr.shape[1:]
+        out = np.full(out_shape, fill, dtype=arr.dtype)
+        mask = self.perm >= 0
+        out[mask] = arr[self.perm[mask]]
+        return out
+
+    def from_hashed(self, arr: np.ndarray) -> np.ndarray:
+        """Hashed → block (``arrFromHashedToBlock``): [D, M, ...] → [N, ...]."""
+        arr = np.asarray(arr)
+        flat = arr.reshape((self.n_shards * self.shard_size,) + arr.shape[2:])
+        return flat[self.inverse]
+
+    # -- device (jitted gathers; XLA inserts the collective) ----------------
+
+    def to_hashed_device(self, arr: jax.Array) -> jax.Array:
+        perm = jnp.asarray(np.where(self.perm >= 0, self.perm, 0))
+        mask = jnp.asarray(self.perm >= 0)
+        out = arr[perm]
+        m = mask[..., None] if arr.ndim == 2 else mask
+        return jnp.where(m, out, 0)
+
+    def from_hashed_device(self, arr: jax.Array) -> jax.Array:
+        inv = jnp.asarray(self.inverse)
+        flat = arr.reshape((self.n_shards * self.shard_size,) + arr.shape[2:])
+        return flat[inv]

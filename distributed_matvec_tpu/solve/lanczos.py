@@ -1,0 +1,155 @@
+"""Lanczos eigensolver over a matvec closure.
+
+The reference drives PRIMME (block Davidson/JDQMR — ``src/PRIMME.chpl``,
+``src/Diagonalize.chpl:258-332``) through three callbacks: the distributed
+matvec, a global sum, and a broadcast (``PRIMME.chpl:267-373``).  PRIMME is a
+native C/Fortran library we don't vendor; the TPU-native replacement is a
+host-orchestrated Lanczos with full reorthogonalization whose inner products
+ride the same engine: for the distributed engine the vectors are hash-sharded
+``[D, M]`` arrays and ``jnp.vdot`` over them is XLA's psum over ICI — exactly
+the ``globalSumReal`` semantics.
+
+Works with *any* vector pytree layout: vectors are whatever ``matvec``
+consumes/produces (``[N]`` for LocalEngine, ``[D, M]`` hashed for
+DistributedEngine; padded slots are zero by engine invariant so dots are
+exact).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.linalg import eigh_tridiagonal
+
+__all__ = ["LanczosResult", "lanczos"]
+
+
+@dataclass
+class LanczosResult:
+    eigenvalues: np.ndarray          # [k] ascending
+    eigenvectors: Optional[list]     # k vectors in the matvec's layout
+    residual_norms: np.ndarray       # [k] |β_m · s_last|  bound
+    num_iters: int
+    converged: bool
+
+
+def _scalar(c, dtype):
+    """A python scalar as a 0-d device constant of the recurrence dtype."""
+    if not np.issubdtype(np.dtype(dtype), np.complexfloating):
+        c = c.real if isinstance(c, complex) else c
+    return jnp.asarray(c, dtype=dtype)
+
+
+def _rand_like(shape, dtype, seed):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal(shape)
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        v = v + 1j * rng.standard_normal(shape)
+    return v.astype(dtype)
+
+
+def lanczos(
+    matvec: Callable,
+    n: Optional[int] = None,
+    k: int = 1,
+    max_iters: int = 300,
+    tol: float = 1e-10,
+    seed: int = 0,
+    v0=None,
+    compute_eigenvectors: bool = False,
+    full_reorth: bool = True,
+) -> LanczosResult:
+    """Lowest-``k`` eigenpairs of the Hermitian operator behind ``matvec``.
+
+    ``v0`` (or ``n`` + ``seed``) fixes the start vector; convergence is the
+    standard residual bound ``|β_m s_m,i| < tol·max(1,|θ_i|)`` for the k
+    lowest Ritz pairs.
+    """
+    if v0 is None:
+        if n is None:
+            raise ValueError("pass v0 or n")
+        v0 = _rand_like((n,), np.float64, seed)
+    v = jnp.asarray(v0)
+    dtype = v.dtype
+    nrm = jnp.sqrt(jnp.real(jnp.vdot(v, v)))
+    v = v / nrm.astype(dtype)
+
+    alphas: List[float] = []
+    betas: List[float] = []
+    V: List[jax.Array] = [v]
+    v_prev = None
+    converged = False
+    m = 0
+    res = None
+
+    for m in range(1, max_iters + 1):
+        w = matvec(V[-1])
+        if isinstance(w, tuple):  # engines returning (y, counters)
+            w = w[0]
+        w = jnp.asarray(w)
+        if m == 1 and w.dtype != dtype:
+            # complex-Hermitian operator applied to a real start vector:
+            # promote the whole recurrence (momentum sectors, symmetry.py)
+            dtype = jnp.promote_types(dtype, w.dtype)
+            V[0] = V[0].astype(dtype)
+        w = w.astype(dtype)
+        # Collective discipline: every inner product is scalarized (blocking)
+        # immediately, so at most one collective program is in flight at a
+        # time.  Overlapping all-reduce programs can deadlock the XLA CPU
+        # collective rendezvous when the device pool is oversubscribed (the
+        # virtual-device test substrate); on TPU this also keeps the solver's
+        # psum latency deterministic.
+        jax.block_until_ready(w)
+        a = float(jnp.real(jnp.vdot(V[-1], w)))
+        w = w - _scalar(a, dtype) * V[-1]
+        if v_prev is not None:
+            w = w - _scalar(betas[-1], dtype) * v_prev
+        if full_reorth:
+            # Two passes of classical Gram-Schmidt against the whole basis.
+            for _ in range(2):
+                for u in V:
+                    c = complex(jnp.vdot(u, w))
+                    w = w - _scalar(c, dtype) * u
+        alphas.append(a)
+        b = float(jnp.sqrt(jnp.real(jnp.vdot(w, w))))
+        # Ritz values + residual bounds from the tridiagonal.
+        kk = min(k, m)
+        theta, S = eigh_tridiagonal(
+            np.array(alphas), np.array(betas),
+            select="i", select_range=(0, kk - 1))
+        res = np.abs(b * S[-1, :])
+        if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
+            converged = True
+            break
+        if b < 1e-14:   # invariant subspace exhausted
+            converged = True
+            break
+        betas.append(b)
+        v_prev = V[-1]
+        v = w / jnp.asarray(b).astype(dtype)
+        V.append(v)
+
+    kk = min(k, len(alphas))
+    theta, S = eigh_tridiagonal(
+        np.array(alphas), np.array(betas[: len(alphas) - 1]),
+        select="i", select_range=(0, kk - 1))
+    evecs = None
+    if compute_eigenvectors:
+        evecs = []
+        for i in range(kk):
+            acc = jnp.zeros_like(V[0])
+            for j, u in enumerate(V[: len(alphas)]):
+                acc = acc + jnp.asarray(S[j, i]).astype(dtype) * u
+            nrm = jnp.sqrt(jnp.real(jnp.vdot(acc, acc)))
+            evecs.append(acc / nrm.astype(dtype))
+    return LanczosResult(
+        eigenvalues=np.asarray(theta),
+        eigenvectors=evecs,
+        residual_norms=np.asarray(res if res is not None else []),
+        num_iters=len(alphas),
+        converged=converged,
+    )

@@ -29,18 +29,13 @@ class RuntimeConfig:
     enumerate_states_num_chunks_per_shard: int = 50  # kEnumerateStatesNumChunks / nL
 
     # -- matvec engine (DistributedMatrixVector.chpl:456-460,55-57) ---------
-    remote_buffer_size: int = 150_000      # kRemoteBufferSize → all_to_all chunk capacity
-    matrix_vector_diagonal_num_chunks: int = 10   # per-shard row chunking of the diag kernel
-    matrix_vector_off_diagonal_num_chunks: int = 1  # row-block loop count (lax.scan length)
+    remote_buffer_size: int = 150_000      # kRemoteBufferSize → fused-mode all_to_all cap
     all_to_all_capacity_factor: float = 1.25  # padding headroom over mean bucket size
 
     # -- device/layout ------------------------------------------------------
     matvec_batch_size: int = 1 << 16       # row block B fed to the off-diag kernel
-    use_float32: bool = False              # accuracy contract needs f64; f32 for speed tests
+    matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
 
-    # -- shuffles (CommonParameters.chpl:3-4) --------------------------------
-    block_to_hashed_num_chunks_factor: int = 2
-    hashed_to_block_num_chunks_factor: int = 2
 
 
 _ENV_PREFIX = "DMT_"

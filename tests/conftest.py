@@ -3,14 +3,22 @@
 Multi-device testing strategy (SURVEY.md §4): the reference tests multi-locale
 runs via GASNet-smp oversubscription on one box; we use XLA's virtual CPU
 device pool instead — 8 virtual CPU devices, as the driver's multichip dry-run
-does.  Must be set before the first ``import jax`` anywhere.
+does.  The environment may pin JAX_PLATFORMS to a hardware backend (and
+sitecustomize may import jax before us), so we *force* the CPU platform via
+jax.config, not setdefault.
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "true"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,156 @@
+"""Multi-device matvec: hash-sharded engine vs LocalEngine vs host matvec.
+
+The analog of the reference's GASNet-smp multi-locale testing
+(SURVEY.md §4): 2/4/8 virtual CPU devices stand in for locales; the
+engine must be bit-compatible with the single-device path at the golden
+tolerances (TestMatrixVectorProduct.chpl:15-16).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu.models.basis import SpinBasis
+from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+from distributed_matvec_tpu.parallel.shuffle import HashedLayout
+
+from test_operator import build_heisenberg
+
+ATOL, RTOL = 1e-13, 1e-12
+
+needs_8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+# -- layout shuffles ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+@pytest.mark.parametrize("batch", [None, 3])
+def test_shuffle_round_trip(n_shards, batch, rng):
+    """Block→hashed→block identity — the Example02 property test
+    (example/Example02.chpl:20-48) on fabricated batched vectors."""
+    states = np.sort(rng.choice(2**40, size=501, replace=False)).astype(np.uint64)
+    layout = HashedLayout(states, n_shards, pad_multiple=8)
+    shape = (states.size,) if batch is None else (states.size, batch)
+    arr = rng.random(shape)
+    hashed = layout.to_hashed(arr)
+    assert hashed.shape[:2] == (n_shards, layout.shard_size)
+    back = layout.from_hashed(hashed)
+    np.testing.assert_array_equal(back, arr)
+    # device path agrees with host path
+    np.testing.assert_array_equal(
+        np.asarray(layout.to_hashed_device(arr)), hashed)
+    np.testing.assert_array_equal(
+        np.asarray(layout.from_hashed_device(hashed)), arr)
+
+
+def test_shuffle_counts_match_hash(rng):
+    states = np.arange(1000, dtype=np.uint64) * np.uint64(2654435761)
+    layout = HashedLayout(np.sort(states), 4, pad_multiple=8)
+    assert layout.counts.sum() == states.size
+    from distributed_matvec_tpu.enumeration.host import shard_index
+
+    owner = shard_index(np.sort(states), 4)
+    np.testing.assert_array_equal(layout.counts, np.bincount(owner, minlength=4))
+
+
+# -- distributed matvec ------------------------------------------------------
+
+DIST_CONFIGS = [
+    # (n, hw, inv, syms, n_devices)
+    (8, 4, None, (), 2),
+    (10, 5, None, (), 4),
+    (12, 6, None, (), 8),
+    (10, 5, -1, (), 8),
+    (12, 6, 1, [([*range(1, 12), 0], 0)], 8),          # chain_24_symm shape
+    (10, 5, None, [([*range(1, 10), 0], 1)], 4),       # complex characters
+]
+
+
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+@pytest.mark.parametrize("n,hw,inv,syms,ndev", DIST_CONFIGS)
+def test_distributed_matches_host(n, hw, inv, syms, ndev, mode, rng):
+    if len(jax.devices()) < ndev:
+        pytest.skip(f"needs {ndev} devices")
+    op = build_heisenberg(n, hw, inv, syms)
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    if not op.effective_is_real:
+        x = x.astype(np.complex128)
+    eng = DistributedEngine(op, n_devices=ndev, mode=mode, batch_size=64)
+    y = eng.matvec_global(x)
+    np.testing.assert_allclose(y, op.matvec_host(x), atol=ATOL, rtol=RTOL)
+
+
+@needs_8
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+def test_distributed_matches_local_engine(mode, rng):
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    op = build_heisenberg(12, 6, 1, [([*range(1, 12), 0], 0)])
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    local = LocalEngine(op, mode=mode)
+    dist = DistributedEngine(op, n_devices=8, mode=mode, batch_size=32)
+    np.testing.assert_allclose(
+        dist.matvec_global(x), np.asarray(local.matvec(x)), atol=ATOL, rtol=RTOL
+    )
+
+
+@needs_8
+@pytest.mark.parametrize("mode", ["ell"])
+def test_distributed_batch(mode, rng):
+    op = build_heisenberg(10, 5, None, ())
+    op.basis.build()
+    n = op.basis.number_states
+    X = rng.random((n, 3)) - 0.5
+    eng = DistributedEngine(op, n_devices=8, mode=mode)
+    Y = eng.from_hashed(eng.matvec(eng.to_hashed(X)))
+    for k in range(3):
+        np.testing.assert_allclose(
+            Y[:, k], op.matvec_host(X[:, k]), atol=ATOL, rtol=RTOL
+        )
+
+
+@needs_8
+def test_fused_overflow_detection(rng):
+    """A deliberately tiny all_to_all capacity must be *detected*, not
+    silently wrong — the analog of the reference's bounded-buffer flow
+    control (DistributedMatrixVector.chpl:456, :638-661)."""
+    from distributed_matvec_tpu.utils.config import update_config
+
+    op = build_heisenberg(12, 6)
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    old = update_config(all_to_all_capacity_factor=1.0, remote_buffer_size=8)
+    try:
+        eng = DistributedEngine(op, n_devices=8, mode="fused", batch_size=128)
+        with pytest.raises(RuntimeError, match="overflow"):
+            eng.matvec(eng.to_hashed(x))
+    finally:
+        update_config(all_to_all_capacity_factor=1.25,
+                      remote_buffer_size=150_000)
+
+
+@needs_8
+def test_distributed_dot_matches_host(rng):
+    op = build_heisenberg(10, 5)
+    op.basis.build()
+    n = op.basis.number_states
+    a, b = rng.random(n), rng.random(n)
+    eng = DistributedEngine(op, n_devices=8)
+    got = float(eng.dot(eng.to_hashed(a), eng.to_hashed(b)))
+    assert abs(got - np.dot(a, b)) < 1e-10
+
+
+def test_graft_entry_dryrun():
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+
+    if len(jax.devices()) >= 8:
+        ge.dryrun_multichip(8)
+    else:
+        pytest.skip("needs 8 devices")

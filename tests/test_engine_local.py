@@ -1,7 +1,8 @@
 """Single-device jitted matvec vs host matvec and the dense reference.
 
 The golden-test contract of TestMatrixVectorProduct.chpl:15-23 (atol 1e-14 /
-rtol 1e-12, full pipeline) applied to the device path.
+rtol 1e-12, full pipeline) applied to the device path, in both engine modes
+(precomputed-ELL and fused/on-the-fly).
 """
 
 import numpy as np
@@ -15,15 +16,16 @@ from test_operator import CONFIGS, build_heisenberg, dense_effective_matrix
 ATOL, RTOL = 1e-13, 1e-12
 
 
+@pytest.mark.parametrize("mode", ["ell", "fused"])
 @pytest.mark.parametrize("n,hw,inv,syms", CONFIGS)
-def test_local_engine_matches_dense(n, hw, inv, syms, rng):
+def test_local_engine_matches_dense(n, hw, inv, syms, mode, rng):
     op = build_heisenberg(n, hw, inv, syms)
     op.basis.build()
     h_eff = dense_effective_matrix(op)
     x = rng.random(op.basis.number_states) - 0.5
     if not op.effective_is_real:
         x = x.astype(np.complex128)
-    eng = LocalEngine(op, batch_size=61)  # force multiple chunks + padding
+    eng = LocalEngine(op, batch_size=61, mode=mode)  # force chunking + padding
     y = np.asarray(eng.matvec(x))
     y_ref = h_eff @ x
     if op.effective_is_real:
@@ -31,25 +33,45 @@ def test_local_engine_matches_dense(n, hw, inv, syms, rng):
     np.testing.assert_allclose(y, y_ref, atol=ATOL, rtol=RTOL)
 
 
-def test_single_chunk_path(rng):
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+def test_single_chunk_path(mode, rng):
     op = build_heisenberg(8, 4)
     op.basis.build()
     x = rng.random(op.basis.number_states) - 0.5
-    eng = LocalEngine(op)  # batch larger than basis → one chunk
+    eng = LocalEngine(op, mode=mode)  # batch larger than basis → one chunk
     assert eng.num_chunks == 1
     y = np.asarray(eng.matvec(x))
     np.testing.assert_allclose(y, op.matvec_host(x), atol=ATOL, rtol=RTOL)
 
 
-def test_engine_detects_sector_violation():
-    """σˣ alone breaks hamming conservation → engine must raise."""
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+def test_batch_matvec_matches_columns(mode, rng):
+    """Rank-2 batches: matvec(X)[:, i] == matvec(X[:, i]) — the numVectors
+    contract of ls_chpl_matrix_vector_product (DistributedMatrixVector.chpl:1095-1110)."""
+    op = build_heisenberg(10, 5, -1)
+    op.basis.build()
+    n = op.basis.number_states
+    X = rng.random((n, 3)) - 0.5
+    eng = LocalEngine(op, batch_size=100, mode=mode)
+    Y = np.asarray(eng.matvec(X))
+    for k in range(X.shape[1]):
+        np.testing.assert_allclose(
+            Y[:, k], np.asarray(eng.matvec(X[:, k])), atol=ATOL, rtol=RTOL
+        )
+
+
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+def test_engine_detects_sector_violation(mode):
+    """σˣ alone breaks hamming conservation → engine must raise (the halt
+    analog of DistributedMatrixVector.chpl:113-118).  In ell mode the check
+    fires at structure-build time, in fused mode on the first matvec."""
     from distributed_matvec_tpu.models.operator import Operator
 
     basis = SpinBasis(6, 3)
     op = Operator.from_expressions(basis, [("σˣ₀", [[0], [1]])])
     basis.build()
-    eng = LocalEngine(op)
     with pytest.raises(RuntimeError, match="outside the basis"):
+        eng = LocalEngine(op, mode=mode)
         eng.matvec(np.ones(basis.number_states))
 
 
@@ -61,3 +83,14 @@ def test_matvec_is_jit_cached(rng):
     y1 = eng.matvec(x)
     y2 = eng.matvec(2 * x)
     np.testing.assert_allclose(2 * np.asarray(y1), np.asarray(y2), atol=1e-13)
+
+
+def test_non_hermitian_rejected():
+    from distributed_matvec_tpu.models.operator import Operator
+
+    basis = SpinBasis(4, 2)
+    op = Operator.from_expressions(basis, [("σ⁺₀ σ⁻₁", [[0, 1]])])
+    basis.build()
+    assert not op.is_hermitian
+    with pytest.raises(ValueError, match="Hermitian"):
+        LocalEngine(op)
