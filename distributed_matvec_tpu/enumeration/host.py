@@ -17,8 +17,8 @@ produced by a *colexicographic recursion*::
 
 which emits states in increasing numeric order using pure array concatenation —
 the vectorized, cache-friendly equivalent of the reference's bit-trick loop.
-A multithreaded C++ kernel (``distributed_matvec_tpu/enumeration/_cpp``) takes
-over for large sectors; this module is the portable reference path.
+The streaming C++ kernel (``_native.cpp`` via ``native.py``) takes over for
+projected sectors; this module is the portable reference path.
 """
 
 from __future__ import annotations
@@ -159,7 +159,7 @@ def enumerate_representatives(
     n_sites: int,
     hamming_weight: Optional[int],
     group,  # SymmetryGroup
-    batch_size: int = 1 << 16,
+    batch_size: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Enumerate symmetry-sector representatives; returns (states, norms).
 
@@ -167,6 +167,11 @@ def enumerate_representatives(
     trivial group → plain state list (norm 1); otherwise batched
     ``is_representative`` filtering (:158-200).  States ascend.
     """
+    if batch_size is None:
+        from ..utils.config import get_config
+
+        # the reference's kIsRepresentativeBatchSize (CommonParameters.chpl:5)
+        batch_size = max(get_config().is_representative_batch_size, 1)
     candidates = all_states(n_sites, hamming_weight)
     if group is None or group.is_trivial:
         return candidates, np.ones(candidates.size, dtype=np.float64)

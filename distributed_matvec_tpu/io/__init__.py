@@ -1,0 +1,9 @@
+"""HDF5 golden/checkpoint I/O (the MyHDF5.chpl layer)."""
+
+from .hdf5 import (  # noqa: F401
+    load_basis,
+    load_eigen,
+    make_or_restore_representatives,
+    save_basis,
+    save_eigen,
+)

@@ -1,0 +1,126 @@
+"""HDF5 I/O + representative checkpoint/restore.
+
+Replaces the reference's ``MyHDF5.chpl`` (direct C-HDF5 hyperslab machinery,
+:26-333) and the compute-or-restore logic of ``Diagonalize.chpl:227-246``:
+
+  * output file layout (groups created by Diagonalize.chpl:276-279):
+      /basis/representatives        u64 [N]
+      /basis/norms                  f64 [N]          (ours; the reference
+                                                      recomputes norms)
+      /hamiltonian/eigenvalues      f64 [k]
+      /hamiltonian/eigenvectors     f64/c128 [k, N]  (row-major like the
+                                                      golden generator's
+                                                      transposed layout,
+                                                      input_for_matvec.py:43-46)
+      /hamiltonian/residuals        f64 [k]
+  * golden-file layout (input_for_matvec.py:28-46): /representatives, /x, /y.
+
+On a sharded run, hashed-layout arrays are converted to block (global sorted)
+order by :class:`~..parallel.shuffle.HashedLayout` before writing — the
+``arrFromHashedToBlock`` step of ``saveEigenvectors`` (Diagonalize.chpl:248-256).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "save_basis",
+    "load_basis",
+    "save_eigen",
+    "load_eigen",
+    "make_or_restore_representatives",
+]
+
+
+def _h5py():
+    try:
+        import h5py
+        return h5py
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "h5py is required for HDF5 I/O; it is unavailable in this "
+            "environment"
+        ) from e
+
+
+def save_basis(path: str, representatives: np.ndarray,
+               norms: Optional[np.ndarray] = None) -> None:
+    """Write /basis/representatives (+ norms) — the checkpoint side of
+    ``makeBasisStates`` (Diagonalize.chpl:237-243, MyHDF5.chpl:309-333)."""
+    h5 = _h5py()
+    with h5.File(path, "a") as f:
+        g = f.require_group("basis")
+        for name in ("representatives", "norms"):
+            if name in g:
+                del g[name]
+        g.create_dataset("representatives",
+                         data=np.asarray(representatives, np.uint64))
+        if norms is not None:
+            g.create_dataset("norms", data=np.asarray(norms, np.float64))
+
+
+def load_basis(path: str) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """(representatives, norms|None) if the checkpoint exists, else None —
+    the restore probe of Diagonalize.chpl:228-235."""
+    import os
+
+    h5 = _h5py()
+    if not os.path.exists(path):
+        return None
+    with h5.File(path, "r") as f:
+        if "basis/representatives" not in f:
+            return None
+        reps = f["basis/representatives"][...].astype(np.uint64)
+        norms = (f["basis/norms"][...].astype(np.float64)
+                 if "basis/norms" in f else None)
+        return reps, norms
+
+
+def make_or_restore_representatives(basis, path: Optional[str]) -> bool:
+    """Build the basis, restoring representatives from ``path`` when present
+    (exact ``makeBasisStates`` semantics, Diagonalize.chpl:227-246).
+
+    Returns True if restored from checkpoint, False if computed (and, when a
+    path is given, checkpointed)."""
+    if path is not None:
+        got = load_basis(path)
+        if got is not None:
+            reps, norms = got
+            basis.unchecked_set_representatives(reps, norms)
+            return True
+    basis.build()
+    if path is not None:
+        save_basis(path, basis.representatives, basis.norms)
+    return False
+
+
+def save_eigen(path: str, eigenvalues: np.ndarray,
+               eigenvectors: Optional[np.ndarray] = None,
+               residuals: Optional[np.ndarray] = None) -> None:
+    """Write /hamiltonian/{eigenvalues,eigenvectors,residuals}
+    (Diagonalize.chpl:248-256)."""
+    h5 = _h5py()
+    with h5.File(path, "a") as f:
+        g = f.require_group("hamiltonian")
+        for name in ("eigenvalues", "eigenvectors", "residuals"):
+            if name in g:
+                del g[name]
+        g.create_dataset("eigenvalues", data=np.asarray(eigenvalues))
+        if eigenvectors is not None:
+            g.create_dataset("eigenvectors", data=np.asarray(eigenvectors))
+        if residuals is not None:
+            g.create_dataset("residuals", data=np.asarray(residuals))
+
+
+def load_eigen(path: str):
+    h5 = _h5py()
+    with h5.File(path, "r") as f:
+        g = f["hamiltonian"]
+        return (
+            g["eigenvalues"][...],
+            g["eigenvectors"][...] if "eigenvectors" in g else None,
+            g["residuals"][...] if "residuals" in g else None,
+        )

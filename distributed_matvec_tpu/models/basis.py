@@ -19,6 +19,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..enumeration import enumerate_representatives as _enumerate
 from ..enumeration import host as _enum
 from .symmetry import SymmetryGroup
 
@@ -123,7 +124,7 @@ class SpinBasis:
         """Enumerate representatives (+ norms).  Reference: ``basis.build()``
         → ``ls_chpl_enumerate_representatives`` (StatesEnumeration.chpl:588-603)."""
         if self._representatives is None or force:
-            states, norms = _enum.enumerate_representatives(
+            states, norms = _enumerate(
                 self.number_spins, self.hamming_weight, self.group
             )
             self._representatives = states

@@ -236,6 +236,70 @@ class SymmetryGroup:
         xor = np.where(self.flip, np.uint64(self.inversion_mask), np.uint64(0))
         return ls, rs, ms, xor
 
+    def coset_walk(self):
+        """Decompose the group for incremental orbit scans.
+
+        Picks the cyclic subgroup ``H = ⟨h⟩`` whose generator ``h`` maximizes
+        period/network-width (for lattice groups: the translation), writes
+        ``G = ∪_j H·c_j``, and returns
+
+            (h_net, coset_nets, elem_idx)
+
+        where ``h_net``/``coset_nets[j]`` are ``(lshift, rshift, mask, xor)``
+        exact-width tuples and ``elem_idx[j][k]`` is the canonical element
+        index of ``h^k·c_j``.  An orbit scan then applies each coset rep once
+        and advances with the cheap ``h`` network — O(Σ|c_j| + G·|h|) work
+        instead of O(G·S_max), which is what makes ``state_info`` fast on
+        device for reflection/inversion-extended translation groups.
+        """
+        index_of = {
+            (p.perm, bool(f)): i
+            for i, (p, f) in enumerate(zip(self.perms, self.flip))
+        }
+
+        def net_of(i: int, flip: bool):
+            net = self.networks[i]  # cached decomposition
+            ls = np.array([max(d, 0) for d in net.shifts], dtype=np.uint64)
+            rs = np.array([max(-d, 0) for d in net.shifts], dtype=np.uint64)
+            ms = np.array(net.masks, dtype=np.uint64)
+            xor = np.uint64(self.inversion_mask if flip else 0)
+            return (ls, rs, ms, xor)
+
+        # Score candidate cyclic generators among *non-flip* elements (flip
+        # composes as a pure xor and is cheaper as part of the coset reps).
+        best, best_score = None, -1.0
+        for i, p in enumerate(self.perms):
+            if self.flip[i]:
+                continue
+            score = p.period() / max(len(self.networks[i].shifts), 1)
+            if score > best_score:
+                best, best_score = i, score
+        h = self.perms[best]
+        period = h.period()
+
+        # H elements as permutation tuples (flip=False throughout H).
+        h_pows = [Permutation.identity(self.n_sites)]
+        for _ in range(period - 1):
+            h_pows.append(h * h_pows[-1])
+
+        seen = set()
+        coset_nets, elem_idx = [], []
+        for j, p in enumerate(self.perms):
+            key = (p.perm, bool(self.flip[j]))
+            if key in seen:
+                continue
+            idxs = []
+            for k in range(period):
+                q = h_pows[k] * p
+                kk = (q.perm, bool(self.flip[j]))
+                seen.add(kk)
+                # spin inversion commutes with any site permutation (it xors
+                # the full n-bit mask), so h^k·c_j carries c_j's flip flag
+                idxs.append(index_of[kk])
+            coset_nets.append(net_of(j, bool(self.flip[j])))
+            elem_idx.append(np.array(idxs, dtype=np.int32))
+        return net_of(best, False), coset_nets, elem_idx
+
     # -- orbit math (host / NumPy) ------------------------------------------
 
     def apply_all(self, states: np.ndarray) -> np.ndarray:

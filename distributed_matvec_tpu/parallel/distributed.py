@@ -157,26 +157,12 @@ class DistributedEngine:
         host-coordinated exchange of *static* query lists.
         """
         D, M, T = self.n_devices, self.shard_size, self.num_terms
-        reps_all = jnp.asarray(alphas_h)  # [D, M] replicated during build
+        from ..enumeration.host import hash64 as hash64_host
 
         @jax.jit
         def build_shard(alphas, norms_a):
-            betas, coeff = K.gather_coefficients(self.tables, alphas, norms_a)
-            owner = (hash64(betas) % jnp.uint64(D)).astype(jnp.int32) \
-                if D > 1 else jnp.zeros(betas.shape, jnp.int32)
-            idx = jnp.zeros(betas.shape, jnp.int32)
-            found = jnp.zeros(betas.shape, bool)
-            for p in range(D):
-                ip, fp = state_index_sorted(reps_all[p], betas.reshape(-1))
-                ip = ip.reshape(betas.shape).astype(jnp.int32)
-                fp = fp.reshape(betas.shape)
-                sel = owner == p
-                idx = jnp.where(sel, ip, idx)
-                found = jnp.where(sel, fp, found)
-            idx, coeff, invalid = K.mask_structure(
-                coeff, idx, found, alphas != SENTINEL_STATE)
-            owner = jnp.where(coeff != 0, owner, -1)
-            return owner, idx, coeff, invalid
+            # orbit scan on device; owner hash + index lookup on host below
+            return K.gather_coefficients(self.tables, alphas, norms_a)
 
         owners = np.empty((D, M, T), np.int32)
         idxs = np.empty((D, M, T), np.int32)
@@ -184,10 +170,28 @@ class DistributedEngine:
                           np.float64 if self.real else np.complex128)
         bad = 0
         for d in range(D):
-            o, i, c, inv = build_shard(jnp.asarray(alphas_h[d]),
-                                       jnp.asarray(norms_h[d]))
-            owners[d], idxs[d], coeffs[d] = np.asarray(o), np.asarray(i), np.asarray(c)
-            bad += int(inv)
+            betas_d, coeff_d = build_shard(jnp.asarray(alphas_h[d]),
+                                           jnp.asarray(norms_h[d]))
+            betas = np.asarray(betas_d)
+            cf = np.asarray(coeff_d)
+            owner = (hash64_host(betas) % np.uint64(D)).astype(np.int32) \
+                if D > 1 else np.zeros(betas.shape, np.int32)
+            idx = np.zeros(betas.shape, np.int64)
+            found = np.zeros(betas.shape, bool)
+            for p in range(D):
+                sel = owner == p
+                ip = np.searchsorted(alphas_h[p], betas[sel])
+                np.clip(ip, 0, M - 1, out=ip)
+                idx[sel] = ip
+                found[sel] = alphas_h[p][ip] == betas[sel]
+            valid_row = (alphas_h[d] != SENTINEL_STATE)[:, None]
+            nz = (cf != 0) & valid_row
+            bad += int((nz & ~found).sum())
+            nz &= found
+            cf = np.where(nz, cf, 0)  # np.asarray(jax) views are read-only
+            idx = np.where(nz, idx, 0)
+            owner = np.where(nz, owner, -1)
+            owners[d], idxs[d], coeffs[d] = owner, idx.astype(np.int32), cf
         if bad:
             raise RuntimeError(
                 f"{bad} generated matrix elements map outside the basis — "
@@ -368,10 +372,10 @@ class DistributedEngine:
                     num_segments=M)
                 return (y, overflow, invalid), None
 
-            init = jax.lax.pvary(
+            init = jax.lax.pcast(
                 (jnp.zeros(M, dtype), jnp.zeros((), jnp.int64),
                  jnp.zeros((), jnp.int64)),
-                SHARD_AXIS,
+                SHARD_AXIS, to="varying",
             )
             (y, overflow, invalid), _ = jax.lax.scan(
                 chunk, init,

@@ -125,8 +125,11 @@ def lanczos(
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
-        if b < 1e-14:   # invariant subspace exhausted
-            converged = True
+        if b < 1e-14:
+            # Krylov space exhausted: every eigenpair it contains is exact,
+            # but if fewer than k were found the start vector was deficient —
+            # report not-converged so callers don't index missing pairs.
+            converged = m >= k
             break
         betas.append(b)
         v_prev = V[-1]

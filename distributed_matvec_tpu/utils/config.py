@@ -26,7 +26,7 @@ class RuntimeConfig:
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize
-    enumerate_states_num_chunks_per_shard: int = 50  # kEnumerateStatesNumChunks / nL
+    enumeration_backend: str = "auto"           # auto | native (C++) | numpy
 
     # -- matvec engine (DistributedMatrixVector.chpl:456-460,55-57) ---------
     remote_buffer_size: int = 150_000      # kRemoteBufferSize → fused-mode all_to_all cap

@@ -119,19 +119,21 @@ def test_fused_overflow_detection(rng):
     """A deliberately tiny all_to_all capacity must be *detected*, not
     silently wrong — the analog of the reference's bounded-buffer flow
     control (DistributedMatrixVector.chpl:456, :638-661)."""
-    from distributed_matvec_tpu.utils.config import update_config
+    from distributed_matvec_tpu.utils.config import get_config, update_config
 
     op = build_heisenberg(12, 6)
     op.basis.build()
     x = rng.random(op.basis.number_states) - 0.5
-    old = update_config(all_to_all_capacity_factor=1.0, remote_buffer_size=8)
+    cfg = get_config()
+    saved = (cfg.all_to_all_capacity_factor, cfg.remote_buffer_size)
+    update_config(all_to_all_capacity_factor=1.0, remote_buffer_size=8)
     try:
         eng = DistributedEngine(op, n_devices=8, mode="fused", batch_size=128)
         with pytest.raises(RuntimeError, match="overflow"):
             eng.matvec(eng.to_hashed(x))
     finally:
-        update_config(all_to_all_capacity_factor=1.25,
-                      remote_buffer_size=150_000)
+        update_config(all_to_all_capacity_factor=saved[0],
+                      remote_buffer_size=saved[1])
 
 
 @needs_8
