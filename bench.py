@@ -3,9 +3,12 @@
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...extras}
 
-``vs_baseline`` is the speedup over the single-node CPU wall-clock measured
-in-process (the NumPy host matvec — the same "beat single-node CPU" contract
-as BASELINE.json's north star).  Extra keys carry per-config detail.
+Headline config is BASELINE.json's target ``heisenberg_chain_32_symm``
+(4 707 969 representatives, |G| = 128).  ``vs_baseline`` is the speedup over
+the single-node CPU wall-clock (NumPy host matvec; for chain_32_symm the CPU
+time is measured on a 65 536-row sample and scaled — the full host apply
+takes ~30 min, which is itself the point).  Extras carry chain-20 and
+chain-24-symm plus Lanczos iters/sec.
 
 Usage: ``python bench.py`` (full, runs on the default JAX backend — the TPU
 chip under the driver); ``python bench.py --smoke`` (small config, CPU-safe).
@@ -19,20 +22,28 @@ import time
 import numpy as np
 
 
-def _bench_config(name, basis_args, edges_fn, repeats=20, host_repeats=3,
-                  solver_iters=0):
+def _build_op(basis_args, n_sites):
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (
+        chain_edges, heisenberg_from_edges)
+
+    basis = SpinBasis(**basis_args)
+    op = heisenberg_from_edges(basis, chain_edges(n_sites))
+    return op
+
+
+def _bench_config(name, basis_args, repeats=20, host_repeats=3,
+                  solver_iters=0, host_sample_rows=None):
     import jax
 
-    from distributed_matvec_tpu.models.basis import SpinBasis
-    from distributed_matvec_tpu.models.lattices import heisenberg_from_edges
     from distributed_matvec_tpu.parallel.engine import LocalEngine
 
+    n_sites = basis_args["number_spins"]
     t0 = time.perf_counter()
-    basis = SpinBasis(**basis_args)
-    op = heisenberg_from_edges(basis, edges_fn(basis.number_spins))
-    basis.build()
+    op = _build_op(basis_args, n_sites)
+    op.basis.build()
     build_s = time.perf_counter() - t0
-    n = basis.number_states
+    n = op.basis.number_states
 
     rng = np.random.default_rng(42)
     x = rng.standard_normal(n)
@@ -49,13 +60,27 @@ def _bench_config(name, basis_args, edges_fn, repeats=20, host_repeats=3,
         y = eng._matvec(xj)[0]
     jax.block_until_ready(y)
     device_ms = (time.perf_counter() - t0) / repeats * 1e3
+    y = np.asarray(y)
 
-    t0 = time.perf_counter()
-    for _ in range(host_repeats):
-        y_host = op.matvec_host(x)
-    host_ms = (time.perf_counter() - t0) / host_repeats * 1e3
-
-    err = float(np.max(np.abs(np.asarray(y) - y_host)))
+    host_estimated = False
+    if host_sample_rows is not None and host_sample_rows < n:
+        # time the host path on a row slice and scale (the full apply is
+        # O(30 min) for chain_32_symm — that gap IS the result)
+        reps = op.basis.representatives
+        sl = slice(0, host_sample_rows)
+        t0 = time.perf_counter()
+        betas, amps = op.apply_off_diag(reps[sl])
+        rep_b, chars, norm_b = op.basis.group.state_info(betas.reshape(-1))
+        idx = op.basis.state_index(rep_b)
+        host_ms = ((time.perf_counter() - t0) * (n / host_sample_rows)) * 1e3
+        host_estimated = True
+        err = float("nan")
+    else:
+        t0 = time.perf_counter()
+        for _ in range(host_repeats):
+            y_host = op.matvec_host(x)
+        host_ms = (time.perf_counter() - t0) / host_repeats * 1e3
+        err = float(np.max(np.abs(y - y_host)))
 
     out = {
         "config": name,
@@ -64,6 +89,7 @@ def _bench_config(name, basis_args, edges_fn, repeats=20, host_repeats=3,
         "engine_init_s": round(init_s, 3),
         "device_ms": round(device_ms, 3),
         "host_numpy_ms": round(host_ms, 3),
+        "host_is_sampled_estimate": host_estimated,
         "speedup_vs_numpy": round(host_ms / device_ms, 2),
         "max_err_vs_host": err,
     }
@@ -79,45 +105,50 @@ def _bench_config(name, basis_args, edges_fn, repeats=20, host_repeats=3,
     return out
 
 
+CHAIN_32_SYMM = dict(number_spins=32, hamming_weight=16, spin_inversion=1,
+                     symmetries=[([*range(1, 32), 0], 0),
+                                 ([*reversed(range(32))], 0)])
+CHAIN_24_SYMM = dict(number_spins=24, hamming_weight=12, spin_inversion=1,
+                     symmetries=[([*range(1, 24), 0], 0),
+                                 ([*reversed(range(24))], 0)])
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small CPU-safe run")
     args = ap.parse_args()
 
-    try:
-        from distributed_matvec_tpu.models.lattices import chain_edges
-    except Exception as e:  # pragma: no cover
-        print(json.dumps({"metric": "error", "value": 0, "unit": "",
-                          "vs_baseline": 0, "error": str(e)}))
-        return 1
-
-    def chain(n):
-        return chain_edges(n)
-
+    detail = {}
     if args.smoke:
         main_cfg = _bench_config(
             "heisenberg_chain_16", dict(number_spins=16, hamming_weight=8),
-            chain, repeats=5, host_repeats=1, solver_iters=20)
-        extras = {}
+            repeats=5, host_repeats=1, solver_iters=20)
     else:
-        main_cfg = _bench_config(
-            "heisenberg_chain_20", dict(number_spins=20, hamming_weight=10),
-            chain, solver_iters=50)
-        extras = {
-            "chain_24_symm": _bench_config(
-                "heisenberg_chain_24_symm",
-                dict(number_spins=24, hamming_weight=12, spin_inversion=1,
-                     symmetries=[([*range(1, 24), 0], 0),
-                                 ([*reversed(range(24))], 0)]),
-                chain, repeats=20, host_repeats=1),
-        }
+        try:
+            detail["chain_20"] = _bench_config(
+                "heisenberg_chain_20",
+                dict(number_spins=20, hamming_weight=10), solver_iters=50)
+        except Exception as e:
+            detail["chain_20"] = {"error": repr(e)}
+        try:
+            detail["chain_24_symm"] = _bench_config(
+                "heisenberg_chain_24_symm", CHAIN_24_SYMM,
+                repeats=20, host_repeats=1, solver_iters=30)
+        except Exception as e:
+            detail["chain_24_symm"] = {"error": repr(e)}
+        try:
+            main_cfg = _bench_config(
+                "heisenberg_chain_32_symm", CHAIN_32_SYMM,
+                repeats=10, host_sample_rows=1 << 16)
+        except Exception as e:
+            main_cfg = dict(detail.get("chain_20") or {}, error=repr(e))
 
     line = {
-        "metric": "Hx_wallclock_ms",
-        "value": main_cfg["device_ms"],
+        "metric": "Hx_wallclock_ms_" + main_cfg.get("config", "unknown"),
+        "value": main_cfg.get("device_ms", 0),
         "unit": "ms",
-        "vs_baseline": main_cfg["speedup_vs_numpy"],
-        "detail": {"main": main_cfg, **extras},
+        "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
+        "detail": {"main": main_cfg, **detail},
     }
     print(json.dumps(line))
     return 0

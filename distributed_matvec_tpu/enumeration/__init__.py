@@ -20,6 +20,8 @@ __all__ = ["host", "enumerate_representatives"]
 def enumerate_representatives(
     n_sites: int, hamming_weight: Optional[int], group
 ) -> Tuple[np.ndarray, np.ndarray]:
+    from ..utils.timers import timed
+
     backend = get_config().enumeration_backend
     projected = group is not None and not group.is_trivial
     spin_inv_only = (
@@ -29,10 +31,13 @@ def enumerate_representatives(
     if backend != "numpy" and projected and not spin_inv_only:
         from . import native
 
-        out = native.enumerate_representatives_native(
-            n_sites, hamming_weight, group)
+        with timed(f"enumerate[native] n={n_sites} hw={hamming_weight} "
+                   f"G={len(group)}"):
+            out = native.enumerate_representatives_native(
+                n_sites, hamming_weight, group)
         if out is not None:
             return out
         if backend == "native":
             raise RuntimeError("native enumeration requested but unavailable")
-    return host.enumerate_representatives(n_sites, hamming_weight, group)
+    with timed(f"enumerate[numpy] n={n_sites} hw={hamming_weight}"):
+        return host.enumerate_representatives(n_sites, hamming_weight, group)

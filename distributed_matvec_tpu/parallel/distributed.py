@@ -144,6 +144,7 @@ class DistributedEngine:
         else:
             self._capacity = self._fused_capacity()
             self._matvec = self._make_fused_matvec()
+        self.timer.report()  # tree print, gated by display_timings
 
     # ------------------------------------------------------------------
     # ELL mode: static routing plan

@@ -124,6 +124,7 @@ class LocalEngine:
         else:
             self._matvec = self._make_fused_matvec()
             self._checked = False
+        self.timer.report()  # tree print, gated by display_timings
 
     # -- structure build (ell mode) -----------------------------------------
 
@@ -153,8 +154,11 @@ class LocalEngine:
         idx_h = np.empty((T, self.n_padded), np.int32)
         coeff_h = np.empty((T, self.n_padded),
                            np.float64 if self.real else np.complex128)
+        from ..utils.logging import log_debug
+
         bad = 0
         for ci in range(C):
+            log_debug(f"ell build chunk {ci}/{C}")
             betas_d, coeff_d = build_chunk(alphas_c[ci], norms_c[ci])
             betas = np.asarray(betas_d)
             cf = np.asarray(coeff_d)

@@ -52,6 +52,97 @@ def _rand_like(shape, dtype, seed):
     return v.astype(dtype)
 
 
+def _lanczos_fast(matvec, v0, k, max_iters, tol, compute_eigenvectors):
+    """Single-device fast path: the Krylov basis lives in a fixed ``[m+1, N]``
+    device buffer and each iteration is one fused program — matvec, the
+    three-term recurrence, and TWO classical-Gram-Schmidt reorth passes as
+    matmuls on the MXU — with only the (α, β) scalars synced to host.
+
+    This is the TPU replacement for PRIMME's blocked orthogonalization: a
+    per-vector dot loop costs ~2m host round-trips per iteration (measured
+    2 iters/s on chain-20); the stacked form runs at matvec speed.
+    """
+    import jax
+
+    v = jnp.asarray(v0)
+    dtype = v.dtype
+    w_probe = matvec(v)
+    if isinstance(w_probe, tuple):
+        w_probe = w_probe[0]
+    dtype = jnp.promote_types(dtype, w_probe.dtype)
+    n = v.shape[0]
+    mmax = max_iters
+
+    V = jnp.zeros((mmax + 1, n), dtype)
+    nrm = jnp.sqrt(jnp.real(jnp.vdot(v, v)))
+    V = V.at[0].set((v / nrm.astype(dtype)).astype(dtype))
+
+    def mv(x):
+        y = matvec(x)
+        return (y[0] if isinstance(y, tuple) else y).astype(dtype)
+
+    @jax.jit
+    def step(V, m, beta_prev):
+        vm = V[m]
+        w = mv(vm)
+        a = jnp.real(jnp.vdot(vm, w))
+        w = w - a.astype(dtype) * vm - beta_prev.astype(dtype) * V[m - 1]
+        # row mask: only the filled 0..m rows participate in reorth
+        mask = (jnp.arange(mmax + 1) <= m).astype(dtype)
+        for _ in range(2):
+            coeffs = (V.conj() @ w) * mask
+            w = w - coeffs @ V
+        b = jnp.sqrt(jnp.real(jnp.vdot(w, w)))
+        V = V.at[m + 1].set((w / jnp.where(b == 0, 1.0, b).astype(dtype)))
+        return V, a, b
+
+    alphas, betas = [], []
+    converged = False
+    res = None
+    beta_prev = jnp.zeros((), jnp.float64)
+    for m in range(max_iters):
+        V, a, b = step(V, m, beta_prev)
+        a, b = float(a), float(b)
+        alphas.append(a)
+        kk = min(k, m + 1)
+        theta, S = eigh_tridiagonal(
+            np.array(alphas), np.array(betas),
+            select="i", select_range=(0, kk - 1))
+        res = np.abs(b * S[-1, :])
+        if m + 1 >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
+            converged = True
+            break
+        if b < 1e-14:
+            converged = (m + 1) >= k
+            break
+        betas.append(b)
+        beta_prev = jnp.asarray(b)
+
+    kk = min(k, len(alphas))
+    theta, S = eigh_tridiagonal(
+        np.array(alphas), np.array(betas[: len(alphas) - 1]),
+        select="i", select_range=(0, kk - 1))
+    evecs = None
+    if compute_eigenvectors:
+        Sj = jnp.asarray(S.astype(np.complex128 if
+                                  np.issubdtype(np.dtype(dtype),
+                                                np.complexfloating)
+                                  else np.float64), dtype=dtype)
+        E = (Sj.T @ V[: len(alphas)])
+        evecs = []
+        for i in range(kk):
+            e = E[i]
+            nrm = jnp.sqrt(jnp.real(jnp.vdot(e, e)))
+            evecs.append(e / nrm.astype(dtype))
+    return LanczosResult(
+        eigenvalues=np.asarray(theta),
+        eigenvectors=evecs,
+        residual_norms=np.asarray(res if res is not None else []),
+        num_iters=len(alphas),
+        converged=converged,
+    )
+
+
 def lanczos(
     matvec: Callable,
     n: Optional[int] = None,
@@ -68,7 +159,17 @@ def lanczos(
     ``v0`` (or ``n`` + ``seed``) fixes the start vector; convergence is the
     standard residual bound ``|β_m s_m,i| < tol·max(1,|θ_i|)`` for the k
     lowest Ritz pairs.
+
+    Rank-1 (single-device) vectors take the fused fast path
+    (:func:`_lanczos_fast`); sharded/hashed vectors use the collective-safe
+    sequential loop below.
     """
+    if v0 is None and n is not None and full_reorth:
+        v0 = _rand_like((n,), np.float64, seed)
+    if (v0 is not None and full_reorth
+            and getattr(np.asarray(v0), "ndim", 0) == 1):
+        return _lanczos_fast(matvec, v0, k, max_iters, tol,
+                             compute_eigenvectors)
     if v0 is None:
         if n is None:
             raise ValueError("pass v0 or n")

@@ -21,7 +21,6 @@ __all__ = ["RuntimeConfig", "get_config", "set_config", "update_config"]
 class RuntimeConfig:
     # -- observability (CommonParameters.chpl:2) ----------------------------
     display_timings: bool = False          # kDisplayTimings
-    verbose_comm: bool = False             # kVerboseComm (DistributedMatrixVector.chpl:19)
     log_debug: bool = False                # logDebug gating (FFI.chpl:78-80)
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
