@@ -54,6 +54,40 @@ def site_operator_matrix(n_sites: int, kind: str, site: int) -> sp.csr_matrix:
     return mat
 
 
+# Fermionic mode matrices in [b_out, b_in] indexing with bit = occupation.
+# Jordan-Wigner parity Z = (−1)^n = diag(+1 empty, −1 occupied); annihilator
+# a|1⟩ = |0⟩ ⇒ a[0, 1] = 1.  Mode ordering: mode 0 is rightmost in the kron
+# chain (fastest index), and the JW string multiplies all modes *below* the
+# target — the convention of ``expression._fermion_atoms`` (s = bits < site).
+_FERMI = {
+    "a": np.array([[0, 1], [0, 0]], dtype=np.complex128),
+    "a+": np.array([[0, 0], [1, 0]], dtype=np.complex128),
+    "Z": np.array([[1, 0], [0, -1]], dtype=np.complex128),
+    "n": np.array([[0, 0], [0, 1]], dtype=np.complex128),
+    "I": np.eye(2, dtype=np.complex128),
+}
+
+
+def fermion_site_operator_matrix(n_sites: int, kind: str, site: int) -> sp.csr_matrix:
+    """Full 2^n matrix of c†/c/n at ``site`` with the Jordan-Wigner string.
+
+    Independent of the production term tables: built purely from Kronecker
+    products of 2×2 mode matrices (c_i = Z⊗…⊗Z⊗a⊗I⊗…⊗I with Z on every
+    mode below i).
+    """
+    local = {"c": "a", "c+": "a+", "n": "n"}[kind]
+    mat = sp.identity(1, dtype=np.complex128, format="csr")
+    for i in range(n_sites):
+        if i == site:
+            m = _FERMI[local]
+        elif i < site and kind in ("c", "c+"):
+            m = _FERMI["Z"]
+        else:
+            m = _FERMI["I"]
+        mat = sp.kron(sp.csr_matrix(m), mat, format="csr")
+    return mat
+
+
 def expression_matrix(
     n_sites: int,
     expr: SymbolicExpression,
@@ -67,8 +101,11 @@ def expression_matrix(
         for term in expr.terms:
             m = sp.identity(dim, dtype=np.complex128, format="csr") * term.coeff
             for family, kind, placeholder in term.factors:
-                assert family == "spin", "dense path covers spin operators"
-                m = m @ site_operator_matrix(n_sites, kind, row[placeholder])
+                site = row[placeholder]
+                if family == "spin":
+                    m = m @ site_operator_matrix(n_sites, kind, site)
+                else:
+                    m = m @ fermion_site_operator_matrix(n_sites, kind, site)
             total = total + m
     return total
 
