@@ -1,15 +1,29 @@
-"""Lanczos eigensolver over a matvec closure.
+"""Thick-restart Lanczos eigensolver over a matvec closure.
 
 The reference drives PRIMME (block Davidson/JDQMR — ``src/PRIMME.chpl``,
 ``src/Diagonalize.chpl:258-332``) through three callbacks: the distributed
 matvec, a global sum, and a broadcast (``PRIMME.chpl:267-373``).  PRIMME is a
 native C/Fortran library we don't vendor; the TPU-native replacement is a
-host-orchestrated Lanczos with full reorthogonalization whose inner products
-ride the same engine: for the distributed engine the vectors are hash-sharded
-``[D, M]`` arrays and ``jnp.vdot`` over them is XLA's psum over ICI — exactly
-the ``globalSumReal`` semantics.
+**device-resident** thick-restart Lanczos:
 
-Works with *any* vector pytree layout: vectors are whatever ``matvec``
+* The Krylov basis lives in a fixed ``[m_cap+1, ...]`` device buffer and a
+  whole *block* of iterations (matvec, two passes of blocked modified
+  Gram-Schmidt as MXU matmuls, the (α, β) recurrence) runs as ONE jitted
+  program (``lax.fori_loop``) with donated buffers — the host only syncs the
+  small (α, β) arrays every ``check_every`` steps for the convergence test.
+  A per-iteration host round-trip costs ~1 s over a tunneled device; the
+  blocked form runs at matvec speed.
+* Memory is bounded by **thick restarting** (the TRLan scheme): when the
+  basis hits ``max_basis_size`` (the analog of the reference's
+  ``kMaxBasisSize``, Diagonalize.chpl:169), the ``min_restart_size`` lowest
+  Ritz vectors are kept (one [l, m]·[m, N] matmul on the MXU) together with
+  the last residual vector; the projected matrix becomes
+  arrowhead-plus-tridiagonal and the recurrence continues.
+* For the distributed engine the vectors are hash-sharded ``[D, M]`` arrays;
+  every inner product XLA emits is a psum over ICI — exactly the
+  ``globalSumReal`` semantics (PRIMME.chpl:267-311).
+
+Works with *any* dense vector layout: vectors are whatever ``matvec``
 consumes/produces (``[N]`` for LocalEngine, ``[D, M]`` hashed for
 DistributedEngine; padded slots are zero by engine invariant so dots are
 exact).
@@ -18,30 +32,29 @@ exact).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from functools import partial
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from scipy.linalg import eigh_tridiagonal
+from scipy.linalg import eigh
 
 __all__ = ["LanczosResult", "lanczos"]
+
+# Row-block size for the blocked Gram-Schmidt sweeps: live basis rows are
+# visited in blocks of this many rows so the sweep cost scales with the
+# *current* basis size m, not the buffer capacity.
+_GS_BLOCK = 8
 
 
 @dataclass
 class LanczosResult:
     eigenvalues: np.ndarray          # [k] ascending
     eigenvectors: Optional[list]     # k vectors in the matvec's layout
-    residual_norms: np.ndarray       # [k] |β_m · s_last|  bound
+    residual_norms: np.ndarray       # [k] |β_m · s_last| bound
     num_iters: int
     converged: bool
-
-
-def _scalar(c, dtype):
-    """A python scalar as a 0-d device constant of the recurrence dtype."""
-    if not np.issubdtype(np.dtype(dtype), np.complexfloating):
-        c = c.real if isinstance(c, complex) else c
-    return jnp.asarray(c, dtype=dtype)
 
 
 def _rand_like(shape, dtype, seed):
@@ -52,95 +65,97 @@ def _rand_like(shape, dtype, seed):
     return v.astype(dtype)
 
 
-def _lanczos_fast(matvec, v0, k, max_iters, tol, compute_eigenvectors):
-    """Single-device fast path: the Krylov basis lives in a fixed ``[m+1, N]``
-    device buffer and each iteration is one fused program — matvec, the
-    three-term recurrence, and TWO classical-Gram-Schmidt reorth passes as
-    matmuls on the MXU — with only the (α, β) scalars synced to host.
+def _projected_matrix(alph, bet, lock_theta, lock_sigma, m):
+    """Rayleigh projection T = V†HV in the current basis ``V[:m]``.
 
-    This is the TPU replacement for PRIMME's blocked orthogonalization: a
-    per-vector dot loop costs ~2m host round-trips per iteration (measured
-    2 iters/s on chain-20); the stacked form runs at matvec speed.
+    Tridiagonal before the first restart; afterwards arrowhead (locked Ritz
+    values on the diagonal, coupling row σ) + tridiagonal tail — the standard
+    thick-restart structure.  Real symmetric even for complex-Hermitian H.
     """
-    import jax
+    l = len(lock_theta)
+    T = np.zeros((m, m))
+    if l:
+        T[:l, :l] = np.diag(lock_theta)
+        T[l, :l] = lock_sigma
+        T[:l, l] = lock_sigma
+    for i in range(l, m):
+        T[i, i] = alph[i]
+    for i in range(l, m - 1):
+        T[i + 1, i] = T[i, i + 1] = bet[i]
+    return T
 
-    v = jnp.asarray(v0)
-    dtype = v.dtype
-    w_probe = matvec(v)
-    if isinstance(w_probe, tuple):
-        w_probe = w_probe[0]
-    dtype = jnp.promote_types(dtype, w_probe.dtype)
-    n = v.shape[0]
-    mmax = max_iters
 
-    V = jnp.zeros((mmax + 1, n), dtype)
-    nrm = jnp.sqrt(jnp.real(jnp.vdot(v, v)))
-    V = V.at[0].set((v / nrm.astype(dtype)).astype(dtype))
+def _buffer_rows(mcap: int) -> int:
+    """V-buffer row count: mcap+1 live rows padded up to a multiple of
+    ``_GS_BLOCK`` so the blocked sweeps' ``dynamic_slice`` never clamps
+    (a clamped start would desynchronize the row mask; pad rows stay zero
+    and contribute nothing)."""
+    return mcap + 1 + (-(mcap + 1)) % _GS_BLOCK
 
-    def mv(x):
-        y = matvec(x)
-        return (y[0] if isinstance(y, tuple) else y).astype(dtype)
 
-    @jax.jit
-    def step(V, m, beta_prev):
-        vm = V[m]
-        w = mv(vm)
-        a = jnp.real(jnp.vdot(vm, w))
-        w = w - a.astype(dtype) * vm - beta_prev.astype(dtype) * V[m - 1]
-        # row mask: only the filled 0..m rows participate in reorth
-        mask = (jnp.arange(mmax + 1) <= m).astype(dtype)
-        for _ in range(2):
-            coeffs = (V.conj() @ w) * mask
-            w = w - coeffs @ V
-        b = jnp.sqrt(jnp.real(jnp.vdot(w, w)))
-        V = V.at[m + 1].set((w / jnp.where(b == 0, 1.0, b).astype(dtype)))
-        return V, a, b
+def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
+    """One jitted program advancing the recurrence by ``nsteps`` iterations.
 
-    alphas, betas = [], []
-    converged = False
-    res = None
-    beta_prev = jnp.zeros((), jnp.float64)
-    for m in range(max_iters):
-        V, a, b = step(V, m, beta_prev)
-        a, b = float(a), float(b)
-        alphas.append(a)
-        kk = min(k, m + 1)
-        theta, S = eigh_tridiagonal(
-            np.array(alphas), np.array(betas),
-            select="i", select_range=(0, kk - 1))
-        res = np.abs(b * S[-1, :])
-        if m + 1 >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
-            converged = True
-            break
-        if b < 1e-14:
-            converged = (m + 1) >= k
-            break
-        betas.append(b)
-        beta_prev = jnp.asarray(b)
+    State: V [_buffer_rows, *shape] basis buffer (donated), alph/bet [mcap]
+    f64.  Each iteration: w = H·V[m]; α = ⟨v, w⟩; ``n_reorth`` passes of
+    blocked MGS against the live rows; β = ‖w‖; V[m+1] = w/β.
+    """
+    nflat = int(np.prod(shape))
+    nrows = _buffer_rows(mcap)
 
-    kk = min(k, len(alphas))
-    theta, S = eigh_tridiagonal(
-        np.array(alphas), np.array(betas[: len(alphas) - 1]),
-        select="i", select_range=(0, kk - 1))
-    evecs = None
-    if compute_eigenvectors:
-        Sj = jnp.asarray(S.astype(np.complex128 if
-                                  np.issubdtype(np.dtype(dtype),
-                                                np.complexfloating)
-                                  else np.float64), dtype=dtype)
-        E = (Sj.T @ V[: len(alphas)])
-        evecs = []
-        for i in range(kk):
-            e = E[i]
-            nrm = jnp.sqrt(jnp.real(jnp.vdot(e, e)))
-            evecs.append(e / nrm.astype(dtype))
-    return LanczosResult(
-        eigenvalues=np.asarray(theta),
-        eigenvectors=evecs,
-        residual_norms=np.asarray(res if res is not None else []),
-        num_iters=len(alphas),
-        converged=converged,
-    )
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def run_block(V, alph, bet, m0, nsteps):
+        def mgs_pass(wf, Vf, m):
+            nblk = (m + 1 + _GS_BLOCK - 1) // _GS_BLOCK
+
+            def blk(j, wf):
+                r0 = j * _GS_BLOCK
+                Vb = jax.lax.dynamic_slice(
+                    Vf, (r0, jnp.zeros((), r0.dtype)), (_GS_BLOCK, nflat))
+                mask = (r0 + jnp.arange(_GS_BLOCK)) <= m
+                c = (Vb.conj() @ wf) * mask.astype(wf.dtype)
+                return wf - c @ Vb
+
+            return jax.lax.fori_loop(0, nblk, blk, wf)
+
+        def body(i, carry):
+            V, alph, bet = carry
+            m = m0 + i
+            Vf = V.reshape(nrows, nflat)
+            vm = jax.lax.dynamic_index_in_dim(Vf, m, keepdims=False)
+            w = mv(vm.reshape(shape))
+            a = jnp.real(jnp.vdot(vm, w))
+            wf = w.reshape(nflat)
+            for _ in range(n_reorth):
+                wf = mgs_pass(wf, Vf, m)
+            b = jnp.sqrt(jnp.real(jnp.vdot(wf, wf)))
+            vnew = (wf / jnp.where(b <= 1e-300, 1.0, b)).astype(dtype)
+            V = jax.lax.dynamic_update_index_in_dim(
+                Vf, vnew, m + 1, axis=0).reshape(V.shape)
+            alph = alph.at[m].set(a)
+            bet = bet.at[m].set(b)
+            return V, alph, bet
+
+        return jax.lax.fori_loop(0, nsteps, body, (V, alph, bet))
+
+    return run_block
+
+
+def _make_restart(mcap, shape, dtype, l):
+    """V[:l] ← SᵀV[:m] (kept Ritz vectors), V[l] ← last residual vector."""
+    nflat = int(np.prod(shape))
+    nrows = _buffer_rows(mcap)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def restart(V, S_l):
+        Vf = V.reshape(nrows, nflat)
+        v_last = Vf[mcap]
+        Y = jnp.tensordot(S_l.astype(dtype), Vf[:mcap], axes=[[0], [0]])
+        Vf = jax.lax.dynamic_update_slice(Vf, Y, (0, 0))
+        Vf = jax.lax.dynamic_update_index_in_dim(Vf, v_last, l, axis=0)
+        return Vf.reshape(V.shape)
+
+    return restart
 
 
 def lanczos(
@@ -153,107 +168,121 @@ def lanczos(
     v0=None,
     compute_eigenvectors: bool = False,
     full_reorth: bool = True,
+    max_basis_size: Optional[int] = None,
+    min_restart_size: Optional[int] = None,
+    check_every: int = 16,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs of the Hermitian operator behind ``matvec``.
 
     ``v0`` (or ``n`` + ``seed``) fixes the start vector; convergence is the
     standard residual bound ``|β_m s_m,i| < tol·max(1,|θ_i|)`` for the k
-    lowest Ritz pairs.
-
-    Rank-1 (single-device) vectors take the fused fast path
-    (:func:`_lanczos_fast`); sharded/hashed vectors use the collective-safe
-    sequential loop below.
+    lowest Ritz pairs.  ``max_basis_size``/``min_restart_size`` mirror the
+    reference driver's ``kMaxBasisSize``/``kMinRestartSize``
+    (Diagonalize.chpl:169-170) and bound device memory at
+    ``(max_basis_size+1)`` vectors via thick restarts.
     """
-    if v0 is None and n is not None and full_reorth:
-        v0 = _rand_like((n,), np.float64, seed)
-    if (v0 is not None and full_reorth
-            and getattr(np.asarray(v0), "ndim", 0) == 1):
-        return _lanczos_fast(matvec, v0, k, max_iters, tol,
-                             compute_eigenvectors)
     if v0 is None:
         if n is None:
             raise ValueError("pass v0 or n")
         v0 = _rand_like((n,), np.float64, seed)
     v = jnp.asarray(v0)
-    dtype = v.dtype
+    shape = v.shape
+
+    # Probe matvec once eagerly: fixes the recurrence dtype (a complex
+    # Hermitian operator promotes a real start vector) and lets engines run
+    # their first-apply counter checks outside of jit.
+    w_probe = matvec(v)
+    if isinstance(w_probe, tuple):
+        w_probe = w_probe[0]
+    dtype = jnp.promote_types(v.dtype, w_probe.dtype)
+    del w_probe
+
+    def mv(x):
+        y = matvec(x)
+        return (y[0] if isinstance(y, tuple) else y).astype(dtype)
+
+    mcap = max_basis_size or min(max(4 * k + 16, 96), max_iters + 1)
+    mcap = max(mcap, k + 2)
+    l_restart = min_restart_size or max(2 * k + 2, min(mcap // 3, 24))
+    l_restart = int(np.clip(l_restart, k, mcap - 2))
+    n_reorth = 2 if full_reorth else 1
+
+    V = jnp.zeros((_buffer_rows(mcap),) + shape, dtype)
     nrm = jnp.sqrt(jnp.real(jnp.vdot(v, v)))
-    v = v / nrm.astype(dtype)
+    V = V.at[0].set((v / nrm.astype(dtype)).astype(dtype))
+    alph_d = jnp.zeros(mcap, jnp.float64)
+    bet_d = jnp.zeros(mcap, jnp.float64)
 
-    alphas: List[float] = []
-    betas: List[float] = []
-    V: List[jax.Array] = [v]
-    v_prev = None
+    run_block = _make_block_runner(mv, mcap, shape, dtype, n_reorth)
+    restart_fn = _make_restart(mcap, shape, dtype, l_restart)
+
+    lock_theta = np.zeros(0)
+    lock_sigma = np.zeros(0)
+    m = 0                       # live basis: V[0..m] (m completed steps)
+    total_iters = 0
     converged = False
-    m = 0
-    res = None
+    theta = S = res = None
 
-    for m in range(1, max_iters + 1):
-        w = matvec(V[-1])
-        if isinstance(w, tuple):  # engines returning (y, counters)
-            w = w[0]
-        w = jnp.asarray(w)
-        if m == 1 and w.dtype != dtype:
-            # complex-Hermitian operator applied to a real start vector:
-            # promote the whole recurrence (momentum sectors, symmetry.py)
-            dtype = jnp.promote_types(dtype, w.dtype)
-            V[0] = V[0].astype(dtype)
-        w = w.astype(dtype)
-        # Collective discipline: every inner product is scalarized (blocking)
-        # immediately, so at most one collective program is in flight at a
-        # time.  Overlapping all-reduce programs can deadlock the XLA CPU
-        # collective rendezvous when the device pool is oversubscribed (the
-        # virtual-device test substrate); on TPU this also keeps the solver's
-        # psum latency deterministic.
-        jax.block_until_ready(w)
-        a = float(jnp.real(jnp.vdot(V[-1], w)))
-        w = w - _scalar(a, dtype) * V[-1]
-        if v_prev is not None:
-            w = w - _scalar(betas[-1], dtype) * v_prev
-        if full_reorth:
-            # Two passes of classical Gram-Schmidt against the whole basis.
-            for _ in range(2):
-                for u in V:
-                    c = complex(jnp.vdot(u, w))
-                    w = w - _scalar(c, dtype) * u
-        alphas.append(a)
-        b = float(jnp.sqrt(jnp.real(jnp.vdot(w, w))))
-        # Ritz values + residual bounds from the tridiagonal.
+    while total_iters < max_iters and not converged:
+        nsteps = min(check_every, mcap - m, max_iters - total_iters)
+        V, alph_d, bet_d = run_block(
+            V, alph_d, bet_d, jnp.int32(m), jnp.int32(nsteps))
+        jax.block_until_ready(V)   # one collective program in flight at a time
+        alph = np.asarray(alph_d)
+        bet = np.asarray(bet_d)
+        m += nsteps
+        total_iters += nsteps
+
+        # Breakdown: a ~zero β means the Krylov space closed at that step;
+        # discard the garbage steps after it.
+        lo = len(lock_theta)
+        broke = None
+        for i in range(max(lo, m - nsteps), m):
+            if bet[i] < 1e-14:
+                broke = i
+                break
+        if broke is not None:
+            m = broke + 1
+
         kk = min(k, m)
-        theta, S = eigh_tridiagonal(
-            np.array(alphas), np.array(betas),
-            select="i", select_range=(0, kk - 1))
-        res = np.abs(b * S[-1, :])
+        T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)
+        theta, S = eigh(T, subset_by_index=(0, kk - 1))
+        res = np.abs(bet[m - 1] * S[m - 1, :])
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
-        if b < 1e-14:
-            # Krylov space exhausted: every eigenpair it contains is exact,
-            # but if fewer than k were found the start vector was deficient —
-            # report not-converged so callers don't index missing pairs.
-            converged = m >= k
-            break
-        betas.append(b)
-        v_prev = V[-1]
-        v = w / jnp.asarray(b).astype(dtype)
-        V.append(v)
+        if broke is not None:
+            break   # Krylov space closed without meeting the tolerance
 
-    kk = min(k, len(alphas))
-    theta, S = eigh_tridiagonal(
-        np.array(alphas), np.array(betas[: len(alphas) - 1]),
-        select="i", select_range=(0, kk - 1))
+        if m == mcap and total_iters < max_iters:
+            # Thick restart: keep the l lowest Ritz vectors + the residual
+            # vector; the projection becomes arrowhead + tridiagonal.
+            l = l_restart   # clipped to <= mcap-2 at setup; restart_fn
+            theta_all, S_all = eigh(T)   # hard-codes the residual row at l
+            V = restart_fn(V, jnp.asarray(S_all[:, :l]))
+            lock_theta = theta_all[:l].copy()
+            lock_sigma = bet[m - 1] * S_all[m - 1, :l]
+            m = l
+
+    kk = min(k, m)
     evecs = None
-    if compute_eigenvectors:
+    if compute_eigenvectors and m:
+        Vf = V.reshape(_buffer_rows(mcap), -1)
+        Sj = jnp.asarray(S[:, :kk].astype(
+            np.complex128 if np.issubdtype(np.dtype(dtype), np.complexfloating)
+            else np.float64), dtype=dtype)
+        E = jnp.tensordot(Sj, Vf[:m], axes=[[0], [0]])
         evecs = []
         for i in range(kk):
-            acc = jnp.zeros_like(V[0])
-            for j, u in enumerate(V[: len(alphas)]):
-                acc = acc + jnp.asarray(S[j, i]).astype(dtype) * u
-            nrm = jnp.sqrt(jnp.real(jnp.vdot(acc, acc)))
-            evecs.append(acc / nrm.astype(dtype))
+            e = E[i]
+            enrm = jnp.sqrt(jnp.real(jnp.vdot(e, e)))
+            evecs.append((e / enrm.astype(dtype)).reshape(shape))
     return LanczosResult(
-        eigenvalues=np.asarray(theta),
+        eigenvalues=np.asarray(theta[:kk]) if theta is not None
+        else np.zeros(0),
         eigenvectors=evecs,
-        residual_norms=np.asarray(res if res is not None else []),
-        num_iters=len(alphas),
+        residual_norms=np.asarray(res[:kk]) if res is not None
+        else np.zeros(0),
+        num_iters=total_iters,
         converged=converged,
     )

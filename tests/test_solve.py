@@ -60,6 +60,24 @@ def test_lanczos_distributed(rng):
     np.testing.assert_allclose(res.eigenvalues[:1], want, atol=1e-9)
 
 
+@pytest.mark.parametrize("mcap", [24, 51])   # 51: not a multiple of the GS
+def test_lanczos_thick_restart(mcap):        # row-block — clamp regression
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((400, 400))
+    A = (A + A.T) / 2
+    import jax.numpy as jnp
+
+    Aj = jnp.asarray(A)
+    res = lanczos(lambda x: Aj @ x, 400, k=2, max_basis_size=mcap,
+                  min_restart_size=8, tol=1e-10, max_iters=400,
+                  compute_eigenvectors=True)
+    want = np.linalg.eigvalsh(A)[:2]
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues, want, atol=1e-8)
+    v = np.asarray(res.eigenvectors[0])
+    assert np.linalg.norm(A @ v - res.eigenvalues[0] * v) < 1e-7
+
+
 def test_lobpcg_ground_state():
     op = build_heisenberg(10, 5)
     op.basis.build()
