@@ -1,0 +1,29 @@
+# Test/bench harness — the analog of the reference Makefile's check targets
+# (/root/reference/Makefile:79-126).  Everything runs from a plain checkout;
+# no install step needed.
+
+PYTHON ?= python
+
+.PHONY: check check-fast check-solve smoke dryrun bench clean
+
+check:
+	$(PYTHON) -m pytest tests/ -q
+
+check-fast:
+	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
+
+check-solve:
+	$(PYTHON) -m pytest tests/test_solve.py tests/test_reference_configs.py -q
+
+smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --smoke
+
+dryrun:
+	$(PYTHON) __graft_entry__.py
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -f distributed_matvec_tpu/enumeration/_native_*.so

@@ -40,7 +40,12 @@ def main(argv=None):
     ap.add_argument("--tol", type=float, default=1e-10,
                     help="residual tolerance (kEps)")
     ap.add_argument("--max-iters", type=int, default=1000,
-                    help="Lanczos iteration cap (kMaxBasisSize analog)")
+                    help="total Lanczos iteration cap")
+    ap.add_argument("--max-basis-size", type=int, default=None,
+                    help="Krylov basis bound before a thick restart "
+                         "(kMaxBasisSize)")
+    ap.add_argument("--min-restart-size", type=int, default=None,
+                    help="Ritz vectors kept at a restart (kMinRestartSize)")
     ap.add_argument("--devices", type=int, default=0,
                     help="shard over an n-device mesh (0 = single device)")
     ap.add_argument("--mode", choices=("ell", "fused"), default="ell",
@@ -108,6 +113,8 @@ def main(argv=None):
             res = lanczos(eng.matvec, n=None if v0 is not None else n,
                           v0=v0, k=args.num_evals, tol=args.tol,
                           max_iters=args.max_iters,
+                          max_basis_size=args.max_basis_size,
+                          min_restart_size=args.min_restart_size,
                           compute_eigenvectors=not args.no_eigenvectors)
             evals, residuals, niter = (res.eigenvalues, res.residual_norms,
                                        res.num_iters)

@@ -26,9 +26,17 @@ Layers (bottom → top; compare SURVEY.md §1):
 # (On TPU, XLA lowers u64/f64 to 32-bit pairs; the hot kernels are
 # integer/VPU-bound so the cost is acceptable — see SURVEY.md §7 hard part 4.)
 try:
+    import os as _os
+
     import jax as _jax
 
     _jax.config.update("jax_enable_x64", True)
+    # sitecustomize may import jax before a launcher's JAX_PLATFORMS env edit
+    # is seen by the plugin registry; re-assert the choice here so
+    # `JAX_PLATFORMS=cpu python …` really keeps every entry point (CLI, bench,
+    # examples, library users) off the TPU tunnel.
+    if _os.environ.get("JAX_PLATFORMS"):
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 except ImportError:  # pragma: no cover - jax is a hard dep in practice
     pass
 

@@ -131,58 +131,57 @@ class LocalEngine:
     def _build_ell(self) -> None:
         """One device pass of the kernels → static [N_pad, T] idx/coeff.
 
-        The orbit scan (canonical β + rescale coefficient) runs on device;
-        the basis *index lookup* runs on the host — u64 binary search is an
-        emulated, gather-heavy op on TPU and measured ~10× slower there than
-        ``np.searchsorted`` (0.65 s vs 0.06 s per 64k-row chunk at N=4.7M).
+        Everything runs on device: the orbit scan (canonical β + rescale),
+        the u64 basis lookup (``searchsorted``; ~0.65 s per 64k-row chunk at
+        N=4.7M on v5e), and table assembly into donated buffers via
+        ``dynamic_update_slice``.  Nothing but the representative array ever
+        crosses the host↔device link — a host-assembled build moves
+        O(N·T·24 B) through it (~4 GB for chain_32_symm), which over a
+        tunneled device link is minutes of pure transfer.  Peak HBM stays at
+        final tables + O(B·T) chunk scratch.
         """
-        n, b, C = self.n_states, self.batch_size, self.num_chunks
+        b, C = self.batch_size, self.num_chunks
         alphas_c = self._alphas.reshape(C, b)
         norms_c = self._norms.reshape(C, b)
-        reps_h = self.operator.basis.representatives
-        alphas_h = np.asarray(self._alphas).reshape(C, b)
-
-        @jax.jit
-        def build_chunk(alphas, norms_a):
-            return K.gather_coefficients(self.tables, alphas, norms_a)
-
-        # Host-assembled build: one device chunk in flight at a time, tables
-        # assembled in host RAM and uploaded once.  Keeps peak HBM at
-        # O(B·T) + final tables (a device-side lax.map + transpose doubles
-        # the peak and OOM-crashed the chip on chain_32_symm).
+        reps = self._reps
         T = self.num_terms
-        idx_h = np.empty((T, self.n_padded), np.int32)
-        coeff_h = np.empty((T, self.n_padded),
-                           np.float64 if self.real else np.complex128)
+        from functools import partial
+
         from ..utils.logging import log_debug
 
-        bad = 0
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def fill_chunk(idx_buf, coeff_buf, bad, alphas, norms_a, start):
+            betas, cf = K.gather_coefficients(self.tables, alphas, norms_a)
+            idx, found = state_index_sorted(reps, betas.reshape(-1))
+            idx, cf, invalid = K.mask_structure(
+                cf, idx.reshape(betas.shape), found.reshape(betas.shape),
+                alphas != SENTINEL_STATE)
+            # Transposed [T, N_pad] layout: the matvec walks terms outermost,
+            # so per-term rows are contiguous (measured ~2× over [N_pad, T]
+            # + axis-1 reduce on v5e).
+            zero = jnp.zeros((), start.dtype)
+            idx_buf = jax.lax.dynamic_update_slice(
+                idx_buf, idx.T.astype(jnp.int32), (zero, start))
+            coeff_buf = jax.lax.dynamic_update_slice(
+                coeff_buf, cf.T, (zero, start))
+            return idx_buf, coeff_buf, bad + invalid
+
+        idx_buf = jnp.zeros((T, self.n_padded), jnp.int32)
+        coeff_buf = jnp.zeros((T, self.n_padded),
+                              jnp.float64 if self.real else jnp.complex128)
+        bad = jnp.zeros((), jnp.int64)
         for ci in range(C):
             log_debug(f"ell build chunk {ci}/{C}")
-            betas_d, coeff_d = build_chunk(alphas_c[ci], norms_c[ci])
-            betas = np.asarray(betas_d)
-            cf = np.asarray(coeff_d)
-            idx = np.searchsorted(reps_h, betas)
-            np.clip(idx, 0, max(n - 1, 0), out=idx)
-            found = reps_h[idx] == betas
-            valid_row = (alphas_h[ci] != SENTINEL_STATE)[:, None]
-            nz = (cf != 0) & valid_row
-            bad += int((nz & ~found).sum())
-            nz &= found
-            cf = np.where(nz, cf, 0)  # np.asarray(jax) views are read-only
-            idx = np.where(nz, idx, 0)
-            idx_h[:, ci * b:(ci + 1) * b] = idx.astype(np.int32).T
-            coeff_h[:, ci * b:(ci + 1) * b] = cf.T
-        if bad:
+            idx_buf, coeff_buf, bad = fill_chunk(
+                idx_buf, coeff_buf, bad, alphas_c[ci], norms_c[ci],
+                jnp.int32(ci * b))
+        if int(bad):
             raise RuntimeError(
-                f"{bad} generated matrix elements map outside the basis — "
-                "operator does not preserve the chosen sector"
+                f"{int(bad)} generated matrix elements map outside the basis "
+                "— operator does not preserve the chosen sector"
             )
-        # Transposed [T, N_pad] layout: the matvec walks terms outermost, so
-        # per-term rows are contiguous (measured ~2× over [N_pad, T] + axis-1
-        # reduce on v5e).
-        self._ell_idx = jnp.asarray(idx_h)
-        self._ell_coeff = jnp.asarray(coeff_h)
+        self._ell_idx = idx_buf
+        self._ell_coeff = coeff_buf
 
     def _make_ell_matvec(self):
         n, n_pad = self.n_states, self.n_padded

@@ -55,6 +55,20 @@ class LanczosResult:
     residual_norms: np.ndarray       # [k] |β_m · s_last| bound
     num_iters: int
     converged: bool
+    # steady-state rate bookkeeping: the first block pays jit compile, so
+    # iters/sec is (num_iters - first_block_iters) / steady_seconds
+    first_block_seconds: float = 0.0
+    first_block_iters: int = 0
+    steady_seconds: float = 0.0
+
+    @property
+    def steady_iters_per_s(self) -> float:
+        """Iteration rate excluding the compile-bearing first block; 0.0 when
+        the solve finished inside the first block (no steady data)."""
+        rest = self.num_iters - self.first_block_iters
+        if rest > 0 and self.steady_seconds > 0:
+            return rest / self.steady_seconds
+        return 0.0
 
 
 def _rand_like(shape, dtype, seed):
@@ -223,11 +237,23 @@ def lanczos(
     converged = False
     theta = S = res = None
 
+    import time as _time
+
+    first_block_s = 0.0
+    first_block_iters = 0
+    steady_s = 0.0
+
     while total_iters < max_iters and not converged:
         nsteps = min(check_every, mcap - m, max_iters - total_iters)
+        t0 = _time.perf_counter()
         V, alph_d, bet_d = run_block(
             V, alph_d, bet_d, jnp.int32(m), jnp.int32(nsteps))
         jax.block_until_ready(V)   # one collective program in flight at a time
+        dt = _time.perf_counter() - t0
+        if first_block_iters == 0:
+            first_block_s, first_block_iters = dt, nsteps
+        else:
+            steady_s += dt
         alph = np.asarray(alph_d)
         bet = np.asarray(bet_d)
         m += nsteps
@@ -285,4 +311,7 @@ def lanczos(
         else np.zeros(0),
         num_iters=total_iters,
         converged=converged,
+        first_block_seconds=first_block_s,
+        first_block_iters=first_block_iters,
+        steady_seconds=steady_s,
     )
