@@ -48,6 +48,14 @@ def main(argv=None):
                     help="Ritz vectors kept at a restart (kMinRestartSize)")
     ap.add_argument("--devices", type=int, default=0,
                     help="shard over an n-device mesh (0 = single device)")
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="multi-host: jax.distributed coordinator address "
+                         "(the GASNet-substrate analog; omit for "
+                         "single-host or cluster auto-detection)")
+    ap.add_argument("--num-processes", type=int, default=None,
+                    help="multi-host: total process count")
+    ap.add_argument("--process-id", type=int, default=None,
+                    help="multi-host: this process's rank")
     ap.add_argument("--mode", choices=("ell", "fused"), default="ell",
                     help="engine mode: precomputed structure or low-memory")
     ap.add_argument("--block", action="store_true",
@@ -67,6 +75,11 @@ def main(argv=None):
     from distributed_matvec_tpu.utils.config import update_config
     from distributed_matvec_tpu.utils.timers import TreeTimer
 
+    if args.coordinator or args.num_processes:
+        from distributed_matvec_tpu.parallel.mesh import init_distributed
+        init_distributed(coordinator_address=args.coordinator,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
     if args.timings:
         update_config(display_timings=True)
     out = args.output or os.path.splitext(args.input)[0] + ".h5"
@@ -97,7 +110,9 @@ def main(argv=None):
             eng = LocalEngine(cfg.hamiltonian, mode=args.mode)
             v0 = None
 
-    with timer.scope("solve"):
+    from distributed_matvec_tpu.utils.profiling import maybe_profile
+
+    with timer.scope("solve"), maybe_profile():
         t0 = time.perf_counter()
         if args.block:
             evals, evecs_cols, iters = lobpcg(

@@ -22,6 +22,11 @@ import time
 import numpy as np
 
 
+def _progress(msg):
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def _build_op(basis_args, n_sites, edges=None):
     from distributed_matvec_tpu.models.basis import SpinBasis
     from distributed_matvec_tpu.models.lattices import (
@@ -40,6 +45,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     from distributed_matvec_tpu.parallel.engine import LocalEngine
 
     n_sites = basis_args["number_spins"]
+    _progress(f"{name}: building basis")
     t0 = time.perf_counter()
     op = _build_op(basis_args, n_sites, edges)
     op.basis.build()
@@ -50,10 +56,12 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     x = rng.standard_normal(n)
     x /= np.linalg.norm(x)
 
+    _progress(f"{name}: N={n}, engine init")
     t0 = time.perf_counter()
     eng = LocalEngine(op, mode="ell")
     init_s = time.perf_counter() - t0
 
+    _progress(f"{name}: engine ready in {init_s:.1f}s, timing matvec")
     xj = jax.numpy.asarray(x)
     y = jax.block_until_ready(eng._matvec(xj)[0])  # compile
     t0 = time.perf_counter()
@@ -61,6 +69,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         y = eng._matvec(xj)[0]
     jax.block_until_ready(y)
     device_ms = (time.perf_counter() - t0) / repeats * 1e3
+    _progress(f"{name}: device {device_ms:.2f} ms/apply, host path next")
     y = np.asarray(y)
 
     host_estimated = False
@@ -98,6 +107,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     if solver_iters:
         from distributed_matvec_tpu.solve.lanczos import lanczos
 
+        _progress(f"{name}: host {host_ms:.0f} ms, lanczos x{solver_iters}")
         t0 = time.perf_counter()
         res = lanczos(eng.matvec, n, k=1, max_iters=solver_iters, seed=42)
         dt = time.perf_counter() - t0

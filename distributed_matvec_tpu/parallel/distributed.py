@@ -126,9 +126,8 @@ class DistributedEngine:
         put = partial(jax.device_put, device=self._sh1)
         self._alphas = put(jnp.asarray(alphas))
         self._norms = put(jnp.asarray(nrm))
-        dd = np.asarray(jax.jit(
-            lambda s: K.apply_diag(self.tables.diag, s)
-        )(jnp.asarray(alphas.reshape(-1)))).reshape(D, M)
+        dd = np.asarray(jax.jit(K.apply_diag)(
+            self.tables.diag, jnp.asarray(alphas.reshape(-1)))).reshape(D, M)
         self._diag = put(jnp.asarray(
             np.where(alphas != SENTINEL_STATE, dd, 0.0)))
 
@@ -161,9 +160,9 @@ class DistributedEngine:
         from ..enumeration.host import hash64 as hash64_host
 
         @jax.jit
-        def build_shard(alphas, norms_a):
+        def build_shard(tables, alphas, norms_a):
             # orbit scan on device; owner hash + index lookup on host below
-            return K.gather_coefficients(self.tables, alphas, norms_a)
+            return K.gather_coefficients(tables, alphas, norms_a)
 
         owners = np.empty((D, M, T), np.int32)
         idxs = np.empty((D, M, T), np.int32)
@@ -171,7 +170,8 @@ class DistributedEngine:
                           np.float64 if self.real else np.complex128)
         bad = 0
         for d in range(D):
-            betas_d, coeff_d = build_shard(jnp.asarray(alphas_h[d]),
+            betas_d, coeff_d = build_shard(self.tables,
+                                           jnp.asarray(alphas_h[d]),
                                            jnp.asarray(norms_h[d]))
             betas = np.asarray(betas_d)
             cf = np.asarray(coeff_d)
@@ -273,23 +273,25 @@ class DistributedEngine:
         spec1 = P(SHARD_AXIS, None)
         spec2 = P(SHARD_AXIS, None, None)
         spec3 = P(SHARD_AXIS, None, None)
+        mesh = self.mesh
 
-        @partial(jax.jit, static_argnames=("batched",))
-        def _mv(x, qin, gidx, coeff, diag, batched):
+        def apply_fn(x, operands):
+            qin, gidx, coeff, diag = operands
+            batched = x.ndim == 3
             xspec = spec2 if batched else spec1
             f = jax.shard_map(
-                shard_body, mesh=self.mesh,
+                shard_body, mesh=mesh,
                 in_specs=(xspec, spec3, spec3, spec3, spec1),
                 out_specs=xspec,
             )
-            return f(x.astype(dtype), qin, gidx, coeff, diag)
+            y = f(x.astype(dtype), qin, gidx, coeff, diag)
+            return y, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64)
 
-        def run(x):
-            return (_mv(x, self._qin, self._ell_idx, self._ell_coeff,
-                        self._diag, batched=(x.ndim == 3)),
-                    jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64))
-
-        return run
+        self._apply_fn = apply_fn
+        self._operands = (self._qin, self._ell_idx, self._ell_coeff,
+                          self._diag)
+        _mv = jax.jit(apply_fn)
+        return lambda x: _mv(x, self._operands)
 
     # ------------------------------------------------------------------
     # Fused mode: dynamic bucketing + all_to_all + segment_sum
@@ -313,9 +315,8 @@ class DistributedEngine:
         nchunks = M // B if M % B == 0 else M // B + 1
         Mp = nchunks * B
         dtype = self._dtype
-        tables = self.tables
 
-        def shard_body(x, alphas, norms):
+        def shard_body(x, alphas, norms, tables):
             x, alphas, norms = x[0], alphas[0], norms[0]
             # pad local arrays to a whole number of chunks
             xp = jnp.pad(x, (0, Mp - M))
@@ -390,30 +391,33 @@ class DistributedEngine:
 
         spec1 = P(SHARD_AXIS, None)
         specs = P(SHARD_AXIS)
+        mesh = self.mesh
 
-        @jax.jit
-        def _mv(x, alphas, norms, diag):
+        def apply_fn(x, operands):
+            alphas, norms, diag, tables = operands
             f = jax.shard_map(
-                shard_body, mesh=self.mesh,
-                in_specs=(spec1, spec1, spec1),
+                shard_body, mesh=mesh,
+                in_specs=(spec1, spec1, spec1, P()),
                 out_specs=(spec1, specs, specs),
             )
-            y, overflow, invalid = f(x.astype(dtype), alphas, norms)
+            y, overflow, invalid = f(x.astype(dtype), alphas, norms, tables)
             y = y + diag.astype(dtype) * x.astype(dtype)
             return y, overflow[0], invalid[0]
+
+        self._apply_fn = apply_fn
+        self._operands = (self._alphas, self._norms, self._diag, self.tables)
+        _mv = jax.jit(apply_fn)
 
         def run(x):
             if x.ndim == 3:
                 # batch: apply per column (fused mode favors memory over speed)
-                cols = [
-                    _mv(x[..., k], self._alphas, self._norms, self._diag)
-                    for k in range(x.shape[-1])
-                ]
+                cols = [_mv(x[..., k], self._operands)
+                        for k in range(x.shape[-1])]
                 y = jnp.stack([c[0] for c in cols], axis=-1)
                 overflow = sum(c[1] for c in cols)
                 invalid = sum(c[2] for c in cols)
                 return y, overflow, invalid
-            return _mv(x, self._alphas, self._norms, self._diag)
+            return _mv(x, self._operands)
 
         return run
 
@@ -475,6 +479,12 @@ class DistributedEngine:
 
     def __call__(self, xh):
         return self.matvec(xh)
+
+    def bound_matvec(self):
+        """(apply_fn, operands) — the matvec as a pure function of
+        ``(x, operands)``; see :meth:`LocalEngine.bound_matvec` for the
+        jit-composition contract (no large closure constants)."""
+        return self._apply_fn, self._operands
 
     @property
     def ell_nbytes(self) -> int:

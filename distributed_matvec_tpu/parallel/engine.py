@@ -111,10 +111,16 @@ class LocalEngine:
         self.tables = K.device_tables(operator)
         self.num_terms = int(self.tables.off.x.shape[0])
 
+        # NOTE on jit hygiene: every large device array (tables, diag, reps)
+        # is passed as an explicit jit *argument*, never closed over — a
+        # closure-captured jax.Array becomes a baked-in constant of the
+        # compiled program, and at chain_32_symm scale (1.9 GB of tables)
+        # constant-embedding turns a 7 s compile into a >30 min one on a
+        # remote device (measured; see also BatchedOperator's re-run-the-
+        # kernels-per-iteration trade the reference makes for memory).
         with self.timer.scope("diag"):
-            self._diag = jax.jit(
-                lambda a: K.apply_diag(self.tables.diag, a)
-            )(self._alphas)                       # [N_pad] f64, pad rows junk→masked
+            self._diag = jax.jit(K.apply_diag)(self.tables.diag, self._alphas)
+            # [N_pad] f64, pad rows junk→masked
 
         if mode == "ell":
             with self.timer.scope("build_structure"):
@@ -150,8 +156,9 @@ class LocalEngine:
         from ..utils.logging import log_debug
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def fill_chunk(idx_buf, coeff_buf, bad, alphas, norms_a, start):
-            betas, cf = K.gather_coefficients(self.tables, alphas, norms_a)
+        def fill_chunk(idx_buf, coeff_buf, bad, tables, reps, alphas,
+                       norms_a, start):
+            betas, cf = K.gather_coefficients(tables, alphas, norms_a)
             idx, found = state_index_sorted(reps, betas.reshape(-1))
             idx, cf, invalid = K.mask_structure(
                 cf, idx.reshape(betas.shape), found.reshape(betas.shape),
@@ -173,8 +180,8 @@ class LocalEngine:
         for ci in range(C):
             log_debug(f"ell build chunk {ci}/{C}")
             idx_buf, coeff_buf, bad = fill_chunk(
-                idx_buf, coeff_buf, bad, alphas_c[ci], norms_c[ci],
-                jnp.int32(ci * b))
+                idx_buf, coeff_buf, bad, self.tables, reps,
+                alphas_c[ci], norms_c[ci], jnp.int32(ci * b))
         if int(bad):
             raise RuntimeError(
                 f"{int(bad)} generated matrix elements map outside the basis "
@@ -184,15 +191,14 @@ class LocalEngine:
         self._ell_coeff = coeff_buf
 
     def _make_ell_matvec(self):
-        n, n_pad = self.n_states, self.n_padded
-        idx, coeff, diag = self._ell_idx, self._ell_coeff, self._diag
-
+        n = self.n_states
         T = self.num_terms
+        dtype = self._dtype
 
-        @jax.jit
-        def _mv(x):
-            x = x.astype(self._dtype)
-            d = diag[:n].astype(self._dtype)
+        def apply_fn(x, operands):
+            idx, coeff, diag = operands
+            x = jnp.asarray(x).astype(dtype)
+            d = diag[:n].astype(dtype)
             y = (d[:, None] if x.ndim == 2 else d) * x
             if T <= 64:
                 # Unrolled per-term gathers — one contiguous coeff row each.
@@ -208,24 +214,25 @@ class LocalEngine:
                 y, _ = jax.lax.scan(step, y, (idx, coeff))
             return y, jnp.zeros((), jnp.int64)
 
-        return _mv
+        self._apply_fn = apply_fn
+        self._operands = (self._ell_idx, self._ell_coeff, self._diag)
+        _mv = jax.jit(apply_fn)
+        return lambda x: _mv(x, self._operands)
 
     # -- fused mode ----------------------------------------------------------
 
     def _make_fused_matvec(self):
         n, b, C = self.n_states, self.batch_size, self.num_chunks
-        alphas_c = self._alphas.reshape(C, b)
-        norms_c = self._norms.reshape(C, b)
-        diag = self._diag
+        dtype = self._dtype
 
-        @jax.jit
-        def _mv(x):
-            x = x.astype(self._dtype)
+        def apply_fn(x, operands):
+            tables, reps, alphas_c, norms_c, diag = operands
+            x = jnp.asarray(x).astype(dtype)
 
             def chunk(args):
                 alphas, norms_a = args
-                betas, coeff = K.gather_coefficients(self.tables, alphas, norms_a)
-                idx, found = state_index_sorted(self._reps, betas.reshape(-1))
+                betas, coeff = K.gather_coefficients(tables, alphas, norms_a)
+                idx, found = state_index_sorted(reps, betas.reshape(-1))
                 idx, coeff, invalid = K.mask_structure(
                     coeff, idx.reshape(betas.shape),
                     found.reshape(betas.shape), alphas != SENTINEL_STATE)
@@ -237,11 +244,16 @@ class LocalEngine:
 
             y_chunks, invalid = jax.lax.map(chunk, (alphas_c, norms_c))
             y = y_chunks.reshape((C * b,) + x.shape[1:])[:n]
-            d = diag[:n].astype(self._dtype)
+            d = diag[:n].astype(dtype)
             y = y + (d[:, None] if x.ndim == 2 else d) * x
             return y, jnp.sum(invalid)
 
-        return _mv
+        self._apply_fn = apply_fn
+        self._operands = (self.tables, self._reps,
+                          self._alphas.reshape(C, b),
+                          self._norms.reshape(C, b), self._diag)
+        _mv = jax.jit(apply_fn)
+        return lambda x: _mv(x, self._operands)
 
     # -- public API ----------------------------------------------------------
 
@@ -266,6 +278,18 @@ class LocalEngine:
 
     def __call__(self, x):
         return self.matvec(x)
+
+    def bound_matvec(self):
+        """(apply_fn, operands): the matvec as a pure function of
+        ``(x, operands)`` with every large array an explicit argument.
+
+        Jit-composition contract: tracing ``engine.matvec`` inside an outer
+        jitted program (e.g. the Lanczos block runner) would capture the
+        tables as baked-in *constants* of that program — see the note in
+        ``__init__``.  Outer programs must close over ``apply_fn`` only and
+        thread ``operands`` through as real arguments.
+        """
+        return self._apply_fn, self._operands
 
     @property
     def ell_nbytes(self) -> int:

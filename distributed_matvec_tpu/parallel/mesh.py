@@ -16,9 +16,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["SHARD_AXIS", "make_mesh", "shard_spec"]
+__all__ = ["SHARD_AXIS", "make_mesh", "shard_spec", "init_distributed"]
 
 SHARD_AXIS = "shards"
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up — the DCN analog of the reference's GASNet
+    substrate env scripts (``env/chpl-env-*.sh``: smp/mpi/ibv/ofi).
+
+    Call once per host *before* any device use; afterwards ``jax.devices()``
+    spans the whole slice, ``make_mesh()`` covers it, and the engine's
+    collectives ride ICI within a slice and DCN across hosts.  Arguments
+    default to cluster auto-detection (Slurm/GKE — the role the reference's
+    Slurm launcher plays, env/chpl-env-snellius.sh).
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -113,12 +113,17 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
     State: V [_buffer_rows, *shape] basis buffer (donated), alph/bet [mcap]
     f64.  Each iteration: w = H·V[m]; α = ⟨v, w⟩; ``n_reorth`` passes of
     blocked MGS against the live rows; β = ‖w‖; V[m+1] = w/β.
+
+    ``mv(x, operands)`` is a pure function: the engine's matrix tables ride
+    in ``operands`` as real jit arguments.  Closing over them instead would
+    bake gigabyte-scale constants into this program (see
+    ``LocalEngine.bound_matvec``).
     """
     nflat = int(np.prod(shape))
     nrows = _buffer_rows(mcap)
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def run_block(V, alph, bet, m0, nsteps):
+    def run_block(V, alph, bet, m0, nsteps, operands):
         def mgs_pass(wf, Vf, m):
             nblk = (m + 1 + _GS_BLOCK - 1) // _GS_BLOCK
 
@@ -137,7 +142,7 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
             m = m0 + i
             Vf = V.reshape(nrows, nflat)
             vm = jax.lax.dynamic_index_in_dim(Vf, m, keepdims=False)
-            w = mv(vm.reshape(shape))
+            w = mv(vm.reshape(shape), operands)
             a = jnp.real(jnp.vdot(vm, w))
             wf = w.reshape(nflat)
             for _ in range(n_reorth):
@@ -211,8 +216,22 @@ def lanczos(
     dtype = jnp.promote_types(v.dtype, w_probe.dtype)
     del w_probe
 
-    def mv(x):
-        y = matvec(x)
+    # Engines expose (apply_fn, operands) so the block runner can pass the
+    # matrix tables as jit arguments; plain callables fall back to empty
+    # operands (fine unless they close over very large device arrays).
+    # Only the engine's own ``matvec`` method is substituted — any other
+    # bound method (shifted/wrapped/global-layout variants) must keep its
+    # semantics and goes through the generic fallback.
+    owner = getattr(matvec, "__self__", None)
+    if (owner is not None and hasattr(owner, "bound_matvec")
+            and getattr(matvec, "__func__", None)
+            is getattr(type(owner), "matvec", None)):
+        apply_fn, operands = owner.bound_matvec()
+    else:
+        apply_fn, operands = (lambda x, _ops: matvec(x)), ()
+
+    def mv(x, ops):
+        y = apply_fn(x, ops)
         return (y[0] if isinstance(y, tuple) else y).astype(dtype)
 
     mcap = max_basis_size or min(max(4 * k + 16, 96), max_iters + 1)
@@ -247,7 +266,7 @@ def lanczos(
         nsteps = min(check_every, mcap - m, max_iters - total_iters)
         t0 = _time.perf_counter()
         V, alph_d, bet_d = run_block(
-            V, alph_d, bet_d, jnp.int32(m), jnp.int32(nsteps))
+            V, alph_d, bet_d, jnp.int32(m), jnp.int32(nsteps), operands)
         jax.block_until_ready(V)   # one collective program in flight at a time
         dt = _time.perf_counter() - t0
         if first_block_iters == 0:

@@ -1,3 +1,3 @@
-"""Cross-cutting utilities: config flags, logging, timers, I/O helpers."""
+"""Cross-cutting utilities: config flags, logging, timers, profiling."""
 
-from . import config, logging, timers  # noqa: F401
+from . import config, logging, profiling, timers  # noqa: F401

@@ -22,6 +22,9 @@ class RuntimeConfig:
     # -- observability (CommonParameters.chpl:2) ----------------------------
     display_timings: bool = False          # kDisplayTimings
     log_debug: bool = False                # logDebug gating (FFI.chpl:78-80)
+    profile_dir: str = ""                  # non-empty → jax.profiler traces
+    #   (the device-side analog of the reference's kVerboseComm/CommDiagnostics
+    #    hooks, DistributedMatrixVector.chpl:19)
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

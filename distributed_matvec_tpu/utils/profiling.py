@@ -1,0 +1,33 @@
+"""Device-level profiling hooks.
+
+The reference carries Chapel ``CommDiagnostics``/``VisualDebug`` hooks behind
+``kVerboseComm`` (``DistributedMatrixVector.chpl:19``, ``v1/basis.chpl:7``);
+the TPU-native analog is a ``jax.profiler`` trace (viewable in TensorBoard /
+Perfetto) gated by the ``profile_dir`` config field (``DMT_PROFILE_DIR=…``).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .config import get_config
+
+__all__ = ["maybe_profile"]
+
+
+@contextmanager
+def maybe_profile(create_perfetto_link: bool = False):
+    """Trace the enclosed block when ``config.profile_dir`` is set; otherwise
+    a no-op.  Usage::
+
+        with maybe_profile():
+            y = eng.matvec(x)
+    """
+    d = get_config().profile_dir
+    if not d:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(d, create_perfetto_link=create_perfetto_link):
+        yield

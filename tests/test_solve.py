@@ -78,6 +78,27 @@ def test_lanczos_thick_restart(mcap):        # row-block — clamp regression
     assert np.linalg.norm(A @ v - res.eigenvalues[0] * v) < 1e-7
 
 
+def test_lanczos_wrapped_method_not_hijacked():
+    """A bound method other than engine.matvec must keep its own semantics
+    (the bound_matvec substitution only applies to the stock matvec)."""
+    import jax.numpy as jnp
+
+    op = build_heisenberg(10, 5)
+    op.basis.build()
+    sigma = 7.0
+
+    class Shifted(LocalEngine):
+        def shifted(self, x):
+            return self.matvec(x) - sigma * jnp.asarray(x)
+
+    sh = Shifted(op)
+    plain = lanczos(LocalEngine(op).matvec, op.basis.number_states, k=1,
+                    tol=1e-10)
+    res = lanczos(sh.shifted, op.basis.number_states, k=1, tol=1e-10)
+    np.testing.assert_allclose(res.eigenvalues[0],
+                               plain.eigenvalues[0] - sigma, atol=1e-8)
+
+
 def test_lobpcg_ground_state():
     op = build_heisenberg(10, 5)
     op.basis.build()
