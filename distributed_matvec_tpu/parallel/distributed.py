@@ -61,7 +61,7 @@ from ..ops.bits import hash64, state_index_sorted
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import SENTINEL_STATE
+from .engine import SENTINEL_STATE, choose_ell_split
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
 
@@ -241,21 +241,68 @@ class DistributedEngine:
                 qq = queries[q][d]
                 qin[d, q, : qq.size] = qq
 
+        g_idx, coeffs, tail = self._split_tables(g_idx, coeffs)
         sh3 = shard_spec(self.mesh, 3)
-        # Transposed [T, M] per shard (see LocalEngine layout note).
+        # Transposed [T0, M] per shard (see LocalEngine layout note).
         self._ell_idx = jax.device_put(
             jnp.asarray(np.swapaxes(g_idx, 1, 2)), sh3)
         self._ell_coeff = jax.device_put(
             jnp.asarray(np.swapaxes(coeffs, 1, 2)), sh3)
+        self._ell_tail = None if tail is None else tuple(
+            jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
+            for a in tail)
         self._qin = jax.device_put(jnp.asarray(qin), sh3)
 
-    def _make_ell_matvec(self):
-        D, M, T, C = (self.n_devices, self.shard_size, self.num_terms,
-                      self.query_capacity)
-        dtype = self._dtype
+    def _split_tables(self, g_idx: np.ndarray, coeffs: np.ndarray):
+        """Two-level split of the [D, M, T] tables (host-side analog of
+        ``LocalEngine._split_ell``): pack each row's nonzeros left, keep a
+        width-``T0`` main table plus a tail over the rows wider than T0.
+        ``T0`` is global (static shapes under shard_map); per-shard tail rows
+        are padded to the widest shard with (row 0, coeff 0) no-ops.  Tail
+        entries are scatter-accumulated, hence the 2× cost weight and the
+        ≤ N/4-rows constraint.
+        """
+        D, M, T = coeffs.shape
+        self._ell_T0 = T
+        if M == 0 or T == 0:
+            return g_idx, coeffs, None
+        nnz = (coeffs != 0).sum(axis=2)                     # [D, M]
+        hist = np.bincount(nnz.reshape(-1), minlength=T + 1)
+        T0, S, Tmax = choose_ell_split(hist, D * M, T,
+                                       real_rows=self.n_states)
+        self._ell_T0 = T0
+        log_debug(f"distributed ell split: T={T} Tmax={Tmax} T0={T0} "
+                  f"tail_rows={S}")
+        if T0 == T:
+            return g_idx, coeffs, None
 
-        def shard_body(x, qin, gidx, coeff, diag):
-            x, qin, gidx, coeff, diag = (a[0] for a in (x, qin, gidx, coeff, diag))
+        order = np.argsort(coeffs == 0, axis=2, kind="stable")   # [D, M, T]
+        g_p = np.take_along_axis(g_idx, order, axis=2)
+        c_p = np.take_along_axis(coeffs, order, axis=2)
+        if S == 0:
+            return g_p[:, :, :T0], c_p[:, :, :T0], None
+
+        S_max = int((nnz > T0).sum(axis=1).max())
+        Tw = Tmax - T0
+        rows = np.zeros((D, S_max), np.int32)
+        idx_t = np.zeros((D, Tw, S_max), np.int32)
+        cf_t = np.zeros((D, Tw, S_max), coeffs.dtype)
+        for d in range(D):
+            rd = np.nonzero(nnz[d] > T0)[0]
+            rows[d, : rd.size] = rd
+            idx_t[d, :, : rd.size] = g_p[d, rd, T0:Tmax].T
+            cf_t[d, :, : rd.size] = c_p[d, rd, T0:Tmax].T
+        return g_p[:, :, :T0], c_p[:, :, :T0], (rows, idx_t, cf_t)
+
+    def _make_ell_matvec(self):
+        D, C = self.n_devices, self.query_capacity
+        T0 = self._ell_T0
+        dtype = self._dtype
+        has_tail = self._ell_tail is not None
+
+        def shard_body(x, qin, gidx, coeff, diag, tail):
+            x, qin, gidx, coeff, diag = (
+                a[0] for a in (x, qin, gidx, coeff, diag))
             batched = x.ndim == 2
             if D > 1:
                 S = x[qin]                      # [D, C(, k)]
@@ -264,32 +311,46 @@ class DistributedEngine:
                     [x, R.reshape((D * C,) + x.shape[1:])], axis=0)
             else:
                 xx = x
+
+            def terms(y, gidx, coeff, width):
+                for t in range(width):
+                    c = coeff[t]
+                    y = y + (c[:, None] if batched else c) * xx[gidx[t]]
+                return y
+
             y = (diag[:, None] if batched else diag).astype(dtype) * x
-            for t in range(T):
-                c = coeff[t]
-                y = y + (c[:, None] if batched else c) * xx[gidx[t]]
+            y = terms(y, gidx, coeff, T0)
+            if has_tail:
+                rows, idx_t, cf_t = (a[0] for a in tail)
+                zshape = (rows.shape[0], x.shape[1]) if batched \
+                    else rows.shape
+                acc = terms(jnp.zeros(zshape, dtype), idx_t, cf_t,
+                            idx_t.shape[0])
+                y = y.at[rows].add(acc, mode="drop")
             return y[None]
 
         spec1 = P(SHARD_AXIS, None)
         spec2 = P(SHARD_AXIS, None, None)
         spec3 = P(SHARD_AXIS, None, None)
+        tail_specs = (spec1, spec3, spec3)
         mesh = self.mesh
 
         def apply_fn(x, operands):
-            qin, gidx, coeff, diag = operands
+            qin, gidx, coeff, diag, tail = operands
             batched = x.ndim == 3
             xspec = spec2 if batched else spec1
             f = jax.shard_map(
                 shard_body, mesh=mesh,
-                in_specs=(xspec, spec3, spec3, spec3, spec1),
+                in_specs=(xspec, spec3, spec3, spec3, spec1,
+                          tail_specs if has_tail else P()),
                 out_specs=xspec,
             )
-            y = f(x.astype(dtype), qin, gidx, coeff, diag)
+            y = f(x.astype(dtype), qin, gidx, coeff, diag, tail)
             return y, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64)
 
         self._apply_fn = apply_fn
         self._operands = (self._qin, self._ell_idx, self._ell_coeff,
-                          self._diag)
+                          self._diag, self._ell_tail)
         _mv = jax.jit(apply_fn)
         return lambda x: _mv(x, self._operands)
 
@@ -490,5 +551,8 @@ class DistributedEngine:
     def ell_nbytes(self) -> int:
         if self.mode != "ell":
             return 0
-        return (self._ell_idx.nbytes + self._ell_coeff.nbytes
-                + self._qin.nbytes)
+        total = (self._ell_idx.nbytes + self._ell_coeff.nbytes
+                 + self._qin.nbytes)
+        if self._ell_tail is not None:
+            total += sum(a.nbytes for a in self._ell_tail)
+        return total

@@ -58,6 +58,39 @@ def pad_to_multiple(n: int, b: int) -> int:
     return ((n + b - 1) // b) * b
 
 
+def choose_ell_split(hist: np.ndarray, n_rows: int, T: int,
+                     real_rows: int | None = None):
+    """Pick the two-level ELL split point from a row-nnz histogram.
+
+    Returns ``(T0, S, Tmax)``: main-table width, number of tail rows, and
+    the widest actual row.  ``T0`` minimizes ``n_rows·t + 2·S(t)·(Tmax−t)``
+    — tail entries are scatter-accumulated, hence the 2× weight — subject to
+    ``S(t) ≤ real_rows/4`` so the scatter stays a small fraction of the
+    *actual* basis (``n_rows`` counts padded rows too — they cost gather
+    slots in the main table but must not widen the tail budget); ``t = Tmax``
+    (pure truncation, empty tail) always qualifies, so the domain is never
+    empty.  Splits saving < 15% of the full-width ``n_rows·T`` entries are
+    rejected as ``(T, 0, Tmax)``.  Shared by ``LocalEngine`` and
+    ``DistributedEngine`` so the tuned constants live in one place.
+    """
+    if n_rows == 0 or T == 0 or not hist.any():
+        return T, 0, 0
+    if real_rows is None:
+        real_rows = n_rows
+    Tmax = int(np.nonzero(hist)[0].max())
+    # rows_gt[t] = number of rows with nnz > t
+    rows_gt = hist[::-1].cumsum()[::-1]
+    rows_gt = np.concatenate([rows_gt[1:], [0]])
+    ts = np.arange(Tmax + 1)
+    cost = n_rows * ts + 2.0 * rows_gt[: Tmax + 1] * (Tmax - ts)
+    cost = np.where(rows_gt[: Tmax + 1] <= real_rows // 4, cost, np.inf)
+    T0 = int(np.argmin(cost))
+    S = int(rows_gt[T0])
+    if (n_rows * T - cost[T0]) < 0.15 * n_rows * T:
+        T0, S = T, 0
+    return T0, S, Tmax
+
+
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):
     pad = n_pad - reps.size
     alphas = np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
@@ -218,23 +251,8 @@ class LocalEngine:
             return nnz, hist
 
         nnz, hist = count(coeff_buf)
-        hist_h = np.asarray(hist)
-        Tmax = int(np.nonzero(hist_h)[0].max())   # widest actual row
-        # rows_gt[t] = number of rows with nnz > t
-        rows_gt = hist_h[::-1].cumsum()[::-1]
-        rows_gt = np.concatenate([rows_gt[1:], [0]])
-        ts = np.arange(Tmax + 1)
-        # Tail entries accumulate via y.at[rows].add — a scatter, the slow
-        # pattern this module exists to avoid — so weight them 2× a gathered
-        # main-table entry, and only allow a tail that is actually a tail
-        # (≤ N/4 rows); t = Tmax (pure truncation, empty tail) always
-        # qualifies, so the argmin domain is never empty.
-        cost = n_pad * ts + 2.0 * rows_gt[: Tmax + 1] * (Tmax - ts)
-        cost = np.where(rows_gt[: Tmax + 1] <= n_pad // 4, cost, np.inf)
-        T0 = int(np.argmin(cost))
-        S = int(rows_gt[T0])
-        if (n_pad * T - cost[T0]) < 0.15 * n_pad * T:
-            T0, S = T, 0     # not worth splitting
+        T0, S, Tmax = choose_ell_split(np.asarray(hist), n_pad, T,
+                                       real_rows=self.n_states)
         self._ell_T0 = T0
         final_entries = n_pad * T if T0 == T \
             else n_pad * T0 + S * (Tmax - T0)
@@ -312,7 +330,8 @@ class LocalEngine:
                         i, c = args
                         contrib = (c[:, None] if batched else c) * x[i]
                         return y + (contrib[:n] if sl else contrib), None
-                    y, _ = jax.lax.scan(step, y, (idx, coeff))
+                    y, _ = jax.lax.scan(step, y,
+                                        (idx[:width], coeff[:width]))
                 return y
 
             d = diag[:n].astype(dtype)

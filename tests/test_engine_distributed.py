@@ -156,3 +156,17 @@ def test_graft_entry_dryrun():
         ge.dryrun_multichip(8)
     else:
         pytest.skip("needs 8 devices")
+
+
+def test_distributed_ell_split_tail_exercised(rng):
+    """The two-level split must trigger on the sharded plan too (global T0,
+    per-shard padded tail) and stay exact vs the host path."""
+    op = build_heisenberg(16, 8, None)
+    op.basis.build()
+    eng = DistributedEngine(op, n_devices=4)
+    assert eng._ell_T0 < eng.num_terms, "split did not trigger"
+    assert eng._ell_tail is not None, "tail path not exercised"
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    np.testing.assert_allclose(eng.matvec_global(x), op.matvec_host(x),
+                               atol=1e-13, rtol=1e-12)

@@ -94,3 +94,65 @@ def test_non_hermitian_rejected():
     assert not op.is_hermitian
     with pytest.raises(ValueError, match="Hermitian"):
         LocalEngine(op)
+
+
+def test_ell_split_tail_path_exercised(rng):
+    """Deterministically drive the two-level ELL split (main + scatter tail).
+
+    A periodic Heisenberg chain in the hamming sector has skewed row widths
+    (~50% fill), so the split must trigger; assert it did — a tail bug must
+    not be able to hide behind an unsplit table — and that the split matvec
+    still matches the host path at golden tolerances.
+    """
+    op = build_heisenberg(16, 8, None)
+    op.basis.build()
+    eng = LocalEngine(op, mode="ell")
+    assert eng._ell_T0 < eng.num_terms, "split did not trigger"
+    assert eng._ell_tail is not None, "tail path not exercised"
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    np.testing.assert_allclose(np.asarray(eng.matvec(x)), op.matvec_host(x),
+                               atol=1e-13, rtol=1e-12)
+    X = np.stack([x, rng.random(n) - 0.5], axis=1)
+    Y = np.asarray(eng.matvec(X))
+    for k in range(2):
+        np.testing.assert_allclose(Y[:, k], op.matvec_host(X[:, k]),
+                                   atol=1e-13, rtol=1e-12)
+
+
+def test_ell_split_cost_model_properties():
+    """choose_ell_split: scatter-heavy layouts are rejected, truncation-only
+    wins are kept, and degenerate histograms fall back to the full table."""
+    from distributed_matvec_tpu.parallel.engine import choose_ell_split
+
+    T, n = 16, 1000
+    # all rows full width → no split possible
+    hist = np.zeros(T + 1, np.int64)
+    hist[T] = n
+    assert choose_ell_split(hist, n, T) == (T, 0, T)
+    # uniform narrow rows → pure truncation (no tail) must be kept
+    hist = np.zeros(T + 1, np.int64)
+    hist[4] = n
+    T0, S, Tmax = choose_ell_split(hist, n, T)
+    assert (T0, S, Tmax) == (4, 0, 4)
+    # a few wide rows over a narrow bulk → split with a small tail
+    hist = np.zeros(T + 1, np.int64)
+    hist[4] = n - 10
+    hist[T] = 10
+    T0, S, Tmax = choose_ell_split(hist, n, T)
+    assert T0 == 4 and S == 10 and Tmax == T
+    # empty basis → full-width fallback, no crash
+    assert choose_ell_split(np.zeros(T + 1, np.int64), 0, T) == (T, 0, 0)
+
+
+def test_ell_split_gate_uses_real_rows():
+    """Padded rows (nnz=0) must not widen the tail budget: with few real
+    rows among many pad rows the whole operator must NOT land in the tail."""
+    from distributed_matvec_tpu.parallel.engine import choose_ell_split
+
+    T = 10
+    hist = np.zeros(T + 1, np.int64)
+    hist[0] = 772       # pad rows
+    hist[T] = 252       # real rows, all full width
+    T0, S, Tmax = choose_ell_split(hist, 1024, T, real_rows=252)
+    assert T0 == T and S == 0, "all-real-rows tail slipped past the gate"
