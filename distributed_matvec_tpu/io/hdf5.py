@@ -31,6 +31,8 @@ __all__ = [
     "load_basis",
     "save_eigen",
     "load_eigen",
+    "save_golden",
+    "load_golden",
     "make_or_restore_representatives",
 ]
 
@@ -124,3 +126,28 @@ def load_eigen(path: str):
             g["eigenvectors"][...] if "eigenvectors" in g else None,
             g["residuals"][...] if "residuals" in g else None,
         )
+
+
+def save_golden(path: str, representatives: np.ndarray, x: np.ndarray,
+                y: np.ndarray) -> None:
+    """Write a golden matvec file: /representatives, /x, /y=Hx — the layout
+    the reference's generator emits (input_for_matvec.py:28-46) and its
+    matvec test consumes (TestMatrixVectorProduct.chpl:25-59).  ``x``/``y``
+    are stored as [k, N] batches (rank-1 input is promoted to k=1, matching
+    the generator's transposed layout, :43-46)."""
+    h5 = _h5py()
+    x = np.atleast_2d(np.asarray(x))
+    y = np.atleast_2d(np.asarray(y))
+    with h5.File(path, "w") as f:
+        f.create_dataset("representatives",
+                         data=np.asarray(representatives, np.uint64))
+        f.create_dataset("x", data=x)
+        f.create_dataset("y", data=y)
+
+
+def load_golden(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(representatives, x [k, N], y [k, N]) from a golden matvec file."""
+    h5 = _h5py()
+    with h5.File(path, "r") as f:
+        return (f["representatives"][...].astype(np.uint64),
+                np.atleast_2d(f["x"][...]), np.atleast_2d(f["y"][...]))

@@ -1,0 +1,72 @@
+"""Golden-file matvec harness — the analog of the reference's
+``TestMatrixVectorProduct.chpl`` (:25-59): load a golden HDF5 file
+(/representatives, /x, /y), rebuild the basis from the YAML config, check
+the enumerated representatives equal the stored ones
+(TestStatesEnumeration.chpl:32), and check engine matvecs against /y at the
+golden tolerances (atol 1e-14 / rtol 1e-12, TestMatrixVectorProduct.chpl:15-16).
+
+Goldens are produced by ``tools/make_golden.py`` (the ``input_for_matvec.py``
+analog, seed 42); here they are generated once per session into a tmp dir
+from the reference's own YAML configs.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu.io.hdf5 import load_golden
+from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+DATA = "/root/reference/data"
+ATOL, RTOL = 1e-14, 1e-12  # TestMatrixVectorProduct.chpl:15-16
+
+CONFIGS = ["heisenberg_chain_10.yaml", "heisenberg_kagome_12.yaml"]
+
+require_data = pytest.mark.skipif(
+    not os.path.isdir(DATA), reason="reference data not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def golden_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("golden")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [sys.executable, os.path.join(repo, "tools", "make_golden.py"),
+            "-o", str(out)] + [os.path.join(DATA, c) for c in CONFIGS]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(args, check=True, env=env, timeout=300)
+    return out
+
+
+@require_data
+@pytest.mark.parametrize("config", CONFIGS)
+def test_golden_matvec(golden_dir, config):
+    name = os.path.splitext(config)[0]
+    reps, x, y = load_golden(os.path.join(golden_dir, "matvec", f"{name}.h5"))
+    cfg = load_config_from_yaml(os.path.join(DATA, config))
+    cfg.basis.build()
+    # representative equality — TestStatesEnumeration.chpl:32
+    np.testing.assert_array_equal(cfg.basis.representatives, reps)
+    eng = LocalEngine(cfg.hamiltonian)
+    for k in range(x.shape[0]):
+        np.testing.assert_allclose(np.asarray(eng.matvec(x[k])), y[k],
+                                   atol=ATOL, rtol=RTOL)
+
+
+@require_data
+def test_golden_matvec_distributed(golden_dir):
+    name = os.path.splitext(CONFIGS[0])[0]
+    reps, x, y = load_golden(
+        os.path.join(golden_dir, "matvec", f"{name}.h5"))
+    cfg = load_config_from_yaml(os.path.join(DATA, CONFIGS[0]))
+    cfg.basis.build()
+    ndev = min(4, len(__import__("jax").devices()))
+    eng = DistributedEngine(cfg.hamiltonian, n_devices=ndev)
+    for k in range(x.shape[0]):
+        np.testing.assert_allclose(eng.matvec_global(x[k]), y[k],
+                                   atol=ATOL, rtol=RTOL)

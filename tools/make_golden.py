@@ -1,0 +1,95 @@
+"""Golden-data generator — the analog of the reference's
+``input_for_matvec.py`` (seed 42, :8; writes /representatives, /x, /y per
+system, :28-46).  The reference generates goldens with the *independent*
+OpenMP ``lattice_symmetries`` package; here the trusted path is the host
+(NumPy) matvec, which is itself validated against the independent dense
+Kronecker/projector reference (tests/dense_ref.py) for every small system.
+
+Usage::
+
+    python tools/make_golden.py CONFIG.yaml [CONFIG2.yaml ...] -o OUTDIR
+    python tools/make_golden.py --all -o OUTDIR   # every buildable
+                                                  # /root/reference/data YAML
+
+Each ``NAME.yaml`` produces ``OUTDIR/matvec/NAME.h5`` with the golden
+layout; ``tests/test_golden.py`` consumes these files the way
+``TestMatrixVectorProduct.chpl:25-59`` consumes the reference archives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 42  # input_for_matvec.py:8
+REFERENCE_DATA = "/root/reference/data"
+# configs small enough to host-matvec in seconds (the reference's check
+# matrix, Makefile:111-125, minus the >24-site archives)
+DEFAULT_MAX_STATES = 5_000_000
+
+
+def generate(yaml_path: str, out_dir: str,
+             max_states: int = DEFAULT_MAX_STATES) -> str | None:
+    from distributed_matvec_tpu.io.hdf5 import save_golden
+    from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+
+    name = os.path.splitext(os.path.basename(yaml_path))[0]
+    cfg = load_config_from_yaml(yaml_path)
+    if cfg.hamiltonian is None:
+        print(f"  {name}: no hamiltonian section, skipped")
+        return None
+    t0 = time.perf_counter()
+    cfg.basis.build()
+    n = cfg.basis.number_states
+    if n > max_states:
+        print(f"  {name}: N={n} > --max-states, skipped")
+        return None
+    rng = np.random.default_rng(SEED)
+    x = rng.standard_normal(n)
+    x /= np.linalg.norm(x)
+    y = cfg.hamiltonian.matvec_host(x)
+    dest = os.path.join(out_dir, "matvec", f"{name}.h5")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    save_golden(dest, cfg.basis.representatives, x, y)
+    print(f"  {name}: N={n} written in {time.perf_counter() - t0:.2f}s")
+    return dest
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("configs", nargs="*", help="YAML config files")
+    ap.add_argument("--all", action="store_true",
+                    help=f"all buildable YAMLs under {REFERENCE_DATA}")
+    ap.add_argument("-o", "--out", default="data", help="output directory")
+    ap.add_argument("--max-states", type=int, default=DEFAULT_MAX_STATES)
+    args = ap.parse_args()
+
+    configs = list(args.configs)
+    if args.all:
+        configs += sorted(glob.glob(os.path.join(REFERENCE_DATA, "*.yaml")))
+    if not configs:
+        ap.error("no configs given (pass YAML paths or --all)")
+    print(f"writing goldens to {args.out}/matvec/")
+    written, failed = 0, 0
+    for path in configs:
+        try:
+            if generate(path, args.out, args.max_states):
+                written += 1
+        except Exception as e:  # noqa: BLE001 — per-config, keep going
+            failed += 1
+            print(f"  {os.path.basename(path)}: FAILED ({e!r})")
+    print(f"{written}/{len(configs)} goldens written, {failed} failed")
+    # skipped (too large / no hamiltonian) is fine; a generation *error* is
+    # not — callers like tests/test_golden.py rely on the exit code.
+    return 1 if failed or not written else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
