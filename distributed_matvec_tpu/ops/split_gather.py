@@ -1,0 +1,96 @@
+"""Exact triple-float32 split gathers for 64-bit values on TPU.
+
+TPU has no native 64-bit types: XLA emulates f64/c128 as 32-bit pairs, and
+an emulated-f64 gather issues *two* index-rate-bound gathers (measured on
+v5e: 42 M elem/s for f64 vs 110 M for f32 — gathers pay per index, not per
+byte).  Splitting ``x`` into three f32 parts ``x = a + b + c`` (24-bit
+mantissa each, 72 ≥ 53 bits total) turns every table gather into ONE gather
+of a ``[..., 3]`` f32 row at the f32 index rate — measured 3.6× faster
+(147 M elem/s) and **bit-exact**:
+
+* ``a = f32(x)``, ``b = f32(x − a)``, ``c = f32(x − a − b)`` — consecutive
+  roundings, so ``b ≲ ulp32(a)``, ``c ≲ ulp32(b)``.
+* Reassembly ``(f64(a) + f64(b)) + f64(c)`` is exact: ``a + b`` spans ≤ 50
+  mantissa bits, and the final add rounds to the representable true value
+  ``x`` itself.
+* Parts smaller than the f32 denormal floor (|x| < ~1e-41) are flushed; the
+  absolute error is < 1e-41 — far below the engine tolerance (atol 1e-14,
+  reference TestMatrixVectorProduct.chpl:15-16) for the solver-normalized
+  vectors the engines consume.
+* Precondition: |x| must stay below f32 max (~3.4e38).  Inf/NaN inputs and
+  finite values beyond that bound poison the split (``f32(x) = inf`` →
+  ``x − inf = NaN``) and the result is NaN — loud, not silently wrong.
+  Engine vectors are solver-normalized, far inside the bound.
+
+complex128 uses six parts (re then im).  The ``split_gather`` config knob
+gates the rewrite: ``"auto"`` (default) enables it exactly when the default
+JAX backend is TPU — on CPU the native f64 gather is faster than
+split + join.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.config import get_config
+
+__all__ = ["split_gather_enabled", "split_parts", "join_parts", "num_parts",
+           "prep_gather"]
+
+
+def split_gather_enabled() -> bool:
+    """True when gathers should use the triple-f32 form (see module doc)."""
+    knob = get_config().split_gather
+    if knob == "on":
+        return True
+    if knob == "off":
+        return False
+    if knob != "auto":
+        raise ValueError(
+            f"unknown split_gather setting {knob!r} (use auto | on | off)")
+    return jax.default_backend() == "tpu"
+
+
+def prep_gather(x, dtype, enabled: bool):
+    """Row-gather closure over ``x``: ``gather(idx) == x[idx]`` numerically.
+
+    When ``enabled``, ``x`` is pre-split once and every gather moves one
+    ``[..., P]`` f32 row instead of an emulated-64-bit element (see module
+    doc); otherwise the plain gather is returned.
+    """
+    if not enabled:
+        return lambda i: x[i]
+    xs = split_parts(x)
+    return lambda i: join_parts(xs[i], dtype)
+
+
+def num_parts(dtype) -> int:
+    return 6 if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating) else 3
+
+
+def _split3(x):
+    a = x.astype(jnp.float32)
+    r = x - a.astype(jnp.float64)
+    b = r.astype(jnp.float32)
+    c = (r - b.astype(jnp.float64)).astype(jnp.float32)
+    return jnp.stack([a, b, c], axis=-1)
+
+
+def _join3(g):
+    return (g[..., 0].astype(jnp.float64) + g[..., 1].astype(jnp.float64)
+            + g[..., 2].astype(jnp.float64))
+
+
+def split_parts(x):
+    """f64 ``[...]`` → f32 ``[..., 3]``; c128 ``[...]`` → f32 ``[..., 6]``."""
+    if jnp.iscomplexobj(x):
+        return jnp.concatenate([_split3(x.real), _split3(x.imag)], axis=-1)
+    return _split3(x)
+
+
+def join_parts(g, dtype):
+    """Inverse of :func:`split_parts` on gathered rows (consumes last axis)."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        return jax.lax.complex(_join3(g[..., :3]), _join3(g[..., 3:]))
+    return _join3(g)

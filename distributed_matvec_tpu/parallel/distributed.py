@@ -58,6 +58,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.operator import Operator
 from ..ops import kernels as K
 from ..ops.bits import hash64, state_index_sorted
+from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
@@ -299,6 +300,7 @@ class DistributedEngine:
         T0 = self._ell_T0
         dtype = self._dtype
         has_tail = self._ell_tail is not None
+        use_sg = split_gather_enabled()
 
         def shard_body(x, qin, gidx, coeff, diag, tail):
             x, qin, gidx, coeff, diag = (
@@ -311,11 +313,12 @@ class DistributedEngine:
                     [x, R.reshape((D * C,) + x.shape[1:])], axis=0)
             else:
                 xx = x
+            gx = prep_gather(xx, dtype, use_sg)
 
             def terms(y, gidx, coeff, width):
                 for t in range(width):
                     c = coeff[t]
-                    y = y + (c[:, None] if batched else c) * xx[gidx[t]]
+                    y = y + (c[:, None] if batched else c) * gx(gidx[t])
                 return y
 
             y = (diag[:, None] if batched else diag).astype(dtype) * x

@@ -44,6 +44,7 @@ import numpy as np
 from ..models.operator import Operator
 from ..ops import kernels as K
 from ..ops.bits import state_index_sorted
+from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
@@ -312,23 +313,25 @@ class LocalEngine:
         T0 = self._ell_T0
         dtype = self._dtype
         has_tail = self._ell_tail is not None
+        use_sg = split_gather_enabled()
 
         def apply_fn(x, operands):
             idx, coeff, diag, tail = operands
             x = jnp.asarray(x).astype(dtype)
             batched = x.ndim == 2
+            gx = prep_gather(x, dtype, use_sg)
 
             def terms(y, idx, coeff, width, sl=None):
                 if width <= 64:
                     # Unrolled per-term gathers — contiguous coeff rows.
                     for t in range(width):
                         c = coeff[t]
-                        acc = (c[:, None] if batched else c) * x[idx[t]]
+                        acc = (c[:, None] if batched else c) * gx(idx[t])
                         y = y + (acc[:n] if sl else acc)
                 else:
                     def step(y, args):
                         i, c = args
-                        contrib = (c[:, None] if batched else c) * x[i]
+                        contrib = (c[:, None] if batched else c) * gx(i)
                         return y + (contrib[:n] if sl else contrib), None
                     y, _ = jax.lax.scan(step, y,
                                         (idx[:width], coeff[:width]))
@@ -357,10 +360,12 @@ class LocalEngine:
     def _make_fused_matvec(self):
         n, b, C = self.n_states, self.batch_size, self.num_chunks
         dtype = self._dtype
+        use_sg = split_gather_enabled()
 
         def apply_fn(x, operands):
             tables, reps, alphas_c, norms_c, diag = operands
             x = jnp.asarray(x).astype(dtype)
+            gx = prep_gather(x, dtype, use_sg)
 
             def chunk(args):
                 alphas, norms_a = args
@@ -369,10 +374,11 @@ class LocalEngine:
                 idx, coeff, invalid = K.mask_structure(
                     coeff, idx.reshape(betas.shape),
                     found.reshape(betas.shape), alphas != SENTINEL_STATE)
+                g = gx(idx)
                 if x.ndim == 2:
-                    yc = jnp.sum(coeff[..., None] * x[idx], axis=1)
+                    yc = jnp.sum(coeff[..., None] * g, axis=1)
                 else:
-                    yc = jnp.sum(coeff * x[idx], axis=1)
+                    yc = jnp.sum(coeff * g, axis=1)
                 return yc, invalid
 
             y_chunks, invalid = jax.lax.map(chunk, (alphas_c, norms_c))

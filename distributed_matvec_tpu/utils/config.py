@@ -37,6 +37,8 @@ class RuntimeConfig:
     # -- device/layout ------------------------------------------------------
     matvec_batch_size: int = 1 << 16       # row block B fed to the off-diag kernel
     matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
+    split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
+    #   (auto = on for the TPU backend; see ops/split_gather.py)
 
 
 

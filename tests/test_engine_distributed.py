@@ -170,3 +170,20 @@ def test_distributed_ell_split_tail_exercised(rng):
     x = rng.random(n) - 0.5
     np.testing.assert_allclose(eng.matvec_global(x), op.matvec_host(x),
                                atol=1e-13, rtol=1e-12)
+
+
+def test_split_gather_distributed_matches_plain(rng):
+    from distributed_matvec_tpu.utils.config import update_config
+
+    op = build_heisenberg(12, 6, None)
+    op.basis.build()
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    update_config(split_gather="off")
+    y_ref = DistributedEngine(op, n_devices=4).matvec_global(x)
+    update_config(split_gather="on")
+    try:
+        y = DistributedEngine(op, n_devices=4).matvec_global(x)
+    finally:
+        update_config(split_gather="auto")
+    np.testing.assert_allclose(y, y_ref, atol=1e-14, rtol=1e-14)

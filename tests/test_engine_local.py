@@ -156,3 +156,36 @@ def test_ell_split_gate_uses_real_rows():
     hist[T] = 252       # real rows, all full width
     T0, S, Tmax = choose_ell_split(hist, 1024, T, real_rows=252)
     assert T0 == T and S == 0, "all-real-rows tail slipped past the gate"
+
+
+def test_split_gather_matches_plain(rng):
+    """Forcing the triple-f32 split-gather path (ops/split_gather.py) must
+    reproduce the plain-gather matvec to the last ulp — f64 and complex
+    sectors, rank-1 and rank-2, ell and fused modes.  (The split/join itself
+    is exact; the residual ~1-ulp wiggle comes from XLA fusing the two
+    separately compiled programs differently, e.g. CPU FMA contraction.)"""
+    from distributed_matvec_tpu.utils.config import update_config
+
+    cases = [
+        build_heisenberg(12, 6, None),                       # f64
+        build_heisenberg(10, 5, None, [([*range(1, 10), 0], 1)]),  # c128
+    ]
+    for op in cases:
+        op.basis.build()
+        n = op.basis.number_states
+        x = rng.random(n) - 0.5
+        X = np.stack([x, rng.random(n) - 0.5], axis=1)
+        for mode in ("ell", "fused"):
+            update_config(split_gather="off")
+            ref_eng = LocalEngine(op, mode=mode)
+            y_ref = np.asarray(ref_eng.matvec(x))
+            Y_ref = np.asarray(ref_eng.matvec(X))
+            update_config(split_gather="on")
+            try:
+                eng = LocalEngine(op, mode=mode)
+                y = np.asarray(eng.matvec(x))
+                Y = np.asarray(eng.matvec(X))
+            finally:
+                update_config(split_gather="auto")
+            np.testing.assert_allclose(y, y_ref, atol=1e-14, rtol=1e-14)
+            np.testing.assert_allclose(Y, Y_ref, atol=1e-14, rtol=1e-14)
