@@ -174,7 +174,7 @@ def main():
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_32_symm", CHAIN_32_SYMM,
-                repeats=10, host_sample_rows=1 << 16, solver_iters=12)
+                repeats=10, host_sample_rows=1 << 16, solver_iters=40)
         except Exception as e:
             main_cfg = dict(detail.get("chain_20") or {}, error=repr(e))
 
