@@ -21,6 +21,10 @@ import time
 
 import numpy as np
 
+from distributed_matvec_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
 
 def _progress(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,

@@ -43,7 +43,7 @@ import numpy as np
 
 from ..models.operator import Operator
 from ..ops import kernels as K
-from ..ops.bits import state_index_sorted
+from ..ops.bits import build_sorted_lookup, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
@@ -141,13 +141,19 @@ class LocalEngine:
 
         reps, norms = basis.representatives, basis.norms
         alphas, nrm = _padded_basis_arrays(reps, norms, n_pad)
-        self._reps = jnp.asarray(reps)            # [N] sorted (search target)
+        # Bucketed basis lookup (replaces searchsorted — see
+        # ops/bits.build_sorted_lookup): device arrays + static ints.
+        pair, dir_tab, self._lk_shift, self._lk_probes = build_sorted_lookup(
+            reps, basis.number_bits)
+        self._lk_pair = jnp.asarray(pair)         # [N, 2] u32
+        self._lk_dir = jnp.asarray(dir_tab)       # [2^b + 1] i32
         self._alphas = jnp.asarray(alphas)        # [N_pad]
         self._norms = jnp.asarray(nrm)            # [N_pad]
         self.tables = K.device_tables(operator)
         self.num_terms = int(self.tables.off.x.shape[0])
 
-        # NOTE on jit hygiene: every large device array (tables, diag, reps)
+        # NOTE on jit hygiene: every large device array (tables, diag, the
+        # lookup pair/directory)
         # is passed as an explicit jit *argument*, never closed over — a
         # closure-captured jax.Array becomes a baked-in constant of the
         # compiled program, and at chain_32_symm scale (1.9 GB of tables)
@@ -185,14 +191,16 @@ class LocalEngine:
         b, C = self.batch_size, self.num_chunks
         alphas_c = self._alphas.reshape(C, b)
         norms_c = self._norms.reshape(C, b)
-        reps = self._reps
         T = self.num_terms
+        lk_shift, lk_probes = self._lk_shift, self._lk_probes
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def fill_chunk(idx_buf, coeff_buf, bad, tables, reps, alphas,
-                       norms_a, start):
+        def fill_chunk(idx_buf, coeff_buf, bad, tables, pair, dir_tab,
+                       alphas, norms_a, start):
             betas, cf = K.gather_coefficients(tables, alphas, norms_a)
-            idx, found = state_index_sorted(reps, betas.reshape(-1))
+            idx, found = state_index_bucketed(
+                pair, dir_tab, betas.reshape(-1),
+                shift=lk_shift, probes=lk_probes)
             idx, cf, invalid = K.mask_structure(
                 cf, idx.reshape(betas.shape), found.reshape(betas.shape),
                 alphas != SENTINEL_STATE)
@@ -213,8 +221,8 @@ class LocalEngine:
         for ci in range(C):
             log_debug(f"ell build chunk {ci}/{C}")
             idx_buf, coeff_buf, bad = fill_chunk(
-                idx_buf, coeff_buf, bad, self.tables, reps,
-                alphas_c[ci], norms_c[ci], jnp.int32(ci * b))
+                idx_buf, coeff_buf, bad, self.tables, self._lk_pair,
+                self._lk_dir, alphas_c[ci], norms_c[ci], jnp.int32(ci * b))
         if int(bad):
             raise RuntimeError(
                 f"{int(bad)} generated matrix elements map outside the basis "
@@ -361,16 +369,19 @@ class LocalEngine:
         n, b, C = self.n_states, self.batch_size, self.num_chunks
         dtype = self._dtype
         use_sg = split_gather_enabled()
+        lk_shift, lk_probes = self._lk_shift, self._lk_probes
 
         def apply_fn(x, operands):
-            tables, reps, alphas_c, norms_c, diag = operands
+            tables, pair, dir_tab, alphas_c, norms_c, diag = operands
             x = jnp.asarray(x).astype(dtype)
             gx = prep_gather(x, dtype, use_sg)
 
             def chunk(args):
                 alphas, norms_a = args
                 betas, coeff = K.gather_coefficients(tables, alphas, norms_a)
-                idx, found = state_index_sorted(reps, betas.reshape(-1))
+                idx, found = state_index_bucketed(
+                    pair, dir_tab, betas.reshape(-1),
+                    shift=lk_shift, probes=lk_probes)
                 idx, coeff, invalid = K.mask_structure(
                     coeff, idx.reshape(betas.shape),
                     found.reshape(betas.shape), alphas != SENTINEL_STATE)
@@ -388,7 +399,7 @@ class LocalEngine:
             return y, jnp.sum(invalid)
 
         self._apply_fn = apply_fn
-        self._operands = (self.tables, self._reps,
+        self._operands = (self.tables, self._lk_pair, self._lk_dir,
                           self._alphas.reshape(C, b),
                           self._norms.reshape(C, b), self._diag)
         _mv = jax.jit(apply_fn)

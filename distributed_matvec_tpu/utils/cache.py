@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache.
+
+First-compile of the engine programs costs tens of seconds per process over
+a tunneled TPU (measured 10.6 s → 0.7 s for a toy program once cached, and
+30-70 s for the structure-build programs).  JAX's persistent cache removes
+that for every process after the first; entry points (bench, CLI, graft
+entry) opt in via :func:`enable_compilation_cache`.  Library code does NOT
+enable it implicitly — the cache directory choice belongs to the harness.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compilation_cache"]
+
+_DEFAULT = os.path.join(os.path.expanduser("~"), ".cache",
+                        "distributed_matvec_tpu", "xla")
+
+
+def enable_compilation_cache(directory: str | None = None) -> str:
+    """Point JAX at a persistent compilation cache directory and return it.
+
+    Respects an existing ``JAX_COMPILATION_CACHE_DIR`` environment setting;
+    otherwise uses ``directory`` or ``~/.cache/distributed_matvec_tpu/xla``.
+    Safe to call multiple times.
+    """
+    import jax
+
+    directory = (os.environ.get("JAX_COMPILATION_CACHE_DIR") or directory
+                 or _DEFAULT)
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    # cache everything that took meaningful compile time
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return directory

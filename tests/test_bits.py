@@ -1,0 +1,57 @@
+"""Device bit-op kernels vs host references: hashing and basis lookup."""
+
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu.enumeration.host import hash64 as hash64_host
+from distributed_matvec_tpu.ops.bits import (build_sorted_lookup, hash64,
+                                             state_index_bucketed,
+                                             state_index_sorted)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_hash64_matches_host(rng):
+    x = rng.integers(0, np.iinfo(np.int64).max, 1000).astype(np.uint64)
+    np.testing.assert_array_equal(np.asarray(hash64(x)), hash64_host(x))
+
+
+@pytest.mark.parametrize("n_bits,n", [(16, 100), (32, 5000), (40, 317)])
+def test_bucketed_lookup_matches_searchsorted(n_bits, n, rng):
+    lim = np.uint64(1) << np.uint64(n_bits)
+    reps = np.sort(rng.choice(
+        np.arange(0, int(lim), max(int(lim) // (4 * n), 1), dtype=np.uint64),
+        n, replace=False))
+    # queries: hits, near-misses, extremes, and out-of-range garbage
+    queries = np.concatenate([
+        rng.choice(reps, n // 2),
+        rng.choice(reps, n // 2) ^ np.uint64(1),
+        np.array([0, int(lim) - 1, np.iinfo(np.uint64).max >> 1],
+                 np.uint64),
+        np.array([np.uint64(0xFFFFFFFFFFFFFFFF)]),
+    ]).astype(np.uint64)
+
+    pair, dir_tab, shift, probes = build_sorted_lookup(reps, n_bits)
+    idx_b, found_b = (np.asarray(a) for a in state_index_bucketed(
+        pair, dir_tab, queries, shift=shift, probes=probes))
+    idx_s, found_s = (np.asarray(a) for a in state_index_sorted(
+        reps, queries))
+
+    ref_found = np.isin(queries, reps)
+    np.testing.assert_array_equal(found_b, ref_found)
+    np.testing.assert_array_equal(found_s, ref_found)
+    np.testing.assert_array_equal(idx_b[ref_found], idx_s[ref_found])
+    assert (reps[idx_b[ref_found]] == queries[ref_found]).all()
+
+
+def test_bucketed_lookup_single_entry():
+    reps = np.array([42], np.uint64)
+    pair, dir_tab, shift, probes = build_sorted_lookup(reps, 8)
+    q = np.array([0, 42, 43, 255], np.uint64)
+    idx, found = (np.asarray(a) for a in state_index_bucketed(
+        pair, dir_tab, q, shift=shift, probes=probes))
+    np.testing.assert_array_equal(found, [False, True, False, False])
+    assert idx[1] == 0
