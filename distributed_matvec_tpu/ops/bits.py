@@ -61,13 +61,25 @@ def state_index_sorted(sorted_reps: jax.Array, states: jax.Array):
     return idx.astype(jnp.int64), found
 
 
-def build_sorted_lookup(reps, n_bits: int, max_dir_bits: int = 24):
+def choose_dir_bits(n: int, n_bits: int, max_dir_bits: int = 24) -> int:
+    """Directory width for an ``n``-entry basis over ``n_bits``-bit states:
+    ~1-entry average buckets, capped by the state width and a memory bound
+    (2^24 × i32 = 64 MB)."""
+    import numpy as np
+
+    return min(max(n_bits, 1),
+               max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1), max_dir_bits)
+
+
+def build_sorted_lookup(reps, n_bits: int, max_dir_bits: int = 24,
+                        dir_bits: int | None = None):
     """Precompute the bucket-directory lookup structure for a sorted basis.
 
     ``jnp.searchsorted`` costs ~log2(N) sequential emulated-u64 gathers per
     query — it dominated the ELL structure build (measured 1.1 s per 2M
     lookups in a 4.7M-state basis on v5e, 96% of the per-chunk time).  The
-    bucketed form cuts that ~4× (measured 22.5 vs 5.4 M lookups/s): a
+    bucketed form cuts that 4–9× (synthetic uniform keys: 22.5 vs 5.4 M
+    lookups/s; the real chain_32_symm reps: 17.2 vs 1.8): a
     directory over the top ``b`` state bits yields a ≲ few-entry bucket, and
     the remaining probes compare (hi, lo) u32 pairs fetched with ONE row
     gather each instead of an emulated 64-bit gather.
@@ -80,8 +92,8 @@ def build_sorted_lookup(reps, n_bits: int, max_dir_bits: int = 24):
 
     reps = np.asarray(reps, dtype=np.uint64)
     n = int(reps.size)
-    b = min(max(n_bits, 1), max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1),
-            max_dir_bits)
+    b = dir_bits if dir_bits is not None \
+        else choose_dir_bits(n, n_bits, max_dir_bits)
     shift = n_bits - b
     edges = np.arange(1 << b, dtype=np.uint64) << np.uint64(shift)
     dir_tab = np.empty((1 << b) + 1, np.int32)
@@ -105,9 +117,10 @@ def state_index_bucketed(pair: jax.Array, dir_tab: jax.Array,
     """
     n = pair.shape[0]
     states = states.astype(jnp.uint64)
-    k = (states >> _U(shift)).astype(jnp.int32) if shift < 64 \
-        else jnp.zeros(states.shape, jnp.int32)
-    k = jnp.minimum(k, dir_tab.shape[0] - 2)
+    # clamp in u64 BEFORE the int32 cast: a garbage state (e.g. SENTINEL)
+    # would wrap negative and index the directory from the end
+    k = jnp.minimum(states >> _U(shift),
+                    _U(dir_tab.shape[0] - 2)).astype(jnp.int32)
     lo = dir_tab[k]
     hi = dir_tab[k + 1]
     s_hi = (states >> _U(32)).astype(jnp.uint32)

@@ -57,7 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.operator import Operator
 from ..ops import kernels as K
-from ..ops.bits import hash64, state_index_sorted
+from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
@@ -142,6 +142,32 @@ class DistributedEngine:
             self._matvec = self._make_ell_matvec()
             self._checked = True
         else:
+            # Per-shard bucketed lookup over each shard's REAL prefix
+            # (SENTINEL pads sort last, so real entries are alphas[d][:count]
+            # and would otherwise pile into the last bucket and inflate
+            # `probes` for every shard).  The directory width is forced
+            # globally from the largest shard so every shard shares one
+            # shift and the stacked [D, 2^b+1] table is uniform.
+            from ..ops.bits import choose_dir_bits
+            counts = self.layout.counts
+            n_bits = basis.number_bits
+            b_global = choose_dir_bits(int(counts.max()), n_bits)
+            lks = [build_sorted_lookup(alphas[d][: counts[d]], n_bits,
+                                       dir_bits=b_global)
+                   for d in range(D)]
+            self._lk_shift = lks[0][2]
+            self._lk_probes = max(lk[3] for lk in lks)
+            pair = np.full((D, M, 2), 0xFFFFFFFF, np.uint32)
+            dir_tab = np.empty((D, lks[0][1].shape[0]), np.int32)
+            for d in range(D):
+                pair[d, : counts[d]] = lks[d][0]
+                if 0 < counts[d] < M:
+                    # pad with the last real row: a probe that clamps past
+                    # the prefix then can't spuriously match SENTINEL queries
+                    pair[d, counts[d]:] = lks[d][0][-1]
+                dir_tab[d] = lks[d][1]
+            self._lk_pair = jax.device_put(jnp.asarray(pair), self._sh2)
+            self._lk_dir = jax.device_put(jnp.asarray(dir_tab), self._sh1)
             self._capacity = self._fused_capacity()
             self._matvec = self._make_fused_matvec()
         self.timer.report()  # tree print, gated by display_timings
@@ -379,9 +405,11 @@ class DistributedEngine:
         nchunks = M // B if M % B == 0 else M // B + 1
         Mp = nchunks * B
         dtype = self._dtype
+        lk_shift, lk_probes = self._lk_shift, self._lk_probes
 
-        def shard_body(x, alphas, norms, tables):
+        def shard_body(x, alphas, norms, tables, lk_pair, lk_dir):
             x, alphas, norms = x[0], alphas[0], norms[0]
+            lk_pair, lk_dir = lk_pair[0], lk_dir[0]
             # pad local arrays to a whole number of chunks
             xp = jnp.pad(x, (0, Mp - M))
             ap = jnp.pad(alphas, (0, Mp - M),
@@ -427,7 +455,9 @@ class DistributedEngine:
                     ).reshape(-1)
                 else:
                     recv_b, recv_a = send_b, send_a
-                idx, found = state_index_sorted(alphas, recv_b)
+                idx, found = state_index_bucketed(
+                    lk_pair, lk_dir, recv_b,
+                    shift=lk_shift, probes=lk_probes)
                 # structural liveness on the receive side: real entries carry
                 # a non-SENTINEL state (padding slots are SENTINEL, amp 0)
                 live_r = recv_b != SENTINEL_STATE
@@ -457,19 +487,23 @@ class DistributedEngine:
         specs = P(SHARD_AXIS)
         mesh = self.mesh
 
+        spec2 = P(SHARD_AXIS, None, None)
+
         def apply_fn(x, operands):
-            alphas, norms, diag, tables = operands
+            alphas, norms, diag, tables, lk_pair, lk_dir = operands
             f = jax.shard_map(
                 shard_body, mesh=mesh,
-                in_specs=(spec1, spec1, spec1, P()),
+                in_specs=(spec1, spec1, spec1, P(), spec2, spec1),
                 out_specs=(spec1, specs, specs),
             )
-            y, overflow, invalid = f(x.astype(dtype), alphas, norms, tables)
+            y, overflow, invalid = f(x.astype(dtype), alphas, norms, tables,
+                                     lk_pair, lk_dir)
             y = y + diag.astype(dtype) * x.astype(dtype)
             return y, overflow[0], invalid[0]
 
         self._apply_fn = apply_fn
-        self._operands = (self._alphas, self._norms, self._diag, self.tables)
+        self._operands = (self._alphas, self._norms, self._diag, self.tables,
+                          self._lk_pair, self._lk_dir)
         _mv = jax.jit(apply_fn)
 
         def run(x):

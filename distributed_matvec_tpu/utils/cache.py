@@ -31,6 +31,8 @@ def enable_compilation_cache(directory: str | None = None) -> str:
                  or _DEFAULT)
     os.makedirs(directory, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", directory)
-    # cache everything that took meaningful compile time
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # cache everything that took meaningful compile time — unless the user
+    # already chose a threshold via the standard env var
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return directory
