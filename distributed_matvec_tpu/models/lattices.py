@@ -17,11 +17,15 @@ __all__ = [
     "heisenberg_from_edges",
     "chain_edges",
     "square_edges",
+    "square_diagonal_edges",
     "kagome_12_edges",
     "kagome_16_edges",
     "heisenberg_chain",
     "heisenberg_square",
     "heisenberg_kagome",
+    "xxz_chain",
+    "transverse_field_ising_chain",
+    "j1j2_square",
 ]
 
 
@@ -37,7 +41,9 @@ def heisenberg_from_edges(
     kagome configs (data/heisenberg_kagome_16.yaml)."""
     sym = "S" if spin_half_ops else "σ"
     sites = [list(e) for e in edges]
-    prefix = "" if coupling == 1.0 else f"{coupling!r} × "
+    # float(...)!r: numpy scalars repr as 'np.float64(x)' under numpy>=2,
+    # which the expression parser rejects
+    prefix = "" if coupling == 1.0 else f"{float(coupling)!r} × "
     exprs = [
         (f"{prefix}{sym}ˣ₀ {sym}ˣ₁", sites),
         (f"{prefix}{sym}ʸ₀ {sym}ʸ₁", sites),
@@ -45,10 +51,11 @@ def heisenberg_from_edges(
     ]
     for j, es in extra:
         s = [list(e) for e in es]
+        jr = f"{float(j)!r}"
         exprs += [
-            (f"{j!r} × {sym}ˣ₀ {sym}ˣ₁", s),
-            (f"{j!r} × {sym}ʸ₀ {sym}ʸ₁", s),
-            (f"{j!r} × {sym}ᶻ₀ {sym}ᶻ₁", s),
+            (f"{jr} × {sym}ˣ₀ {sym}ˣ₁", s),
+            (f"{jr} × {sym}ʸ₀ {sym}ʸ₁", s),
+            (f"{jr} × {sym}ᶻ₀ {sym}ᶻ₁", s),
         ]
     return Operator.from_expressions(basis, exprs, name="Heisenberg Hamiltonian")
 
@@ -137,3 +144,63 @@ def heisenberg_kagome(n: int) -> Operator:
         raise ValueError(f"no kagome cluster with {n} sites")
     basis = SpinBasis(n, n // 2)
     return heisenberg_from_edges(basis, edges, spin_half_ops=True)
+
+
+# ---------------------------------------------------------------------------
+# Beyond the reference's shipped configs: the same expression compiler covers
+# any σ-product Hamiltonian; these are standard families users expect.
+# ---------------------------------------------------------------------------
+
+
+def xxz_chain(
+    n: int,
+    delta: float = 1.0,
+    hamming_weight: Optional[int] = None,
+    symmetric: bool = False,
+) -> Operator:
+    """XXZ ring: Σ σˣσˣ + σʸσʸ + Δ·σᶻσᶻ (Δ=1 is the Heisenberg point)."""
+    if hamming_weight is None:
+        hamming_weight = n // 2
+    syms = [(_translation(n), 0), (_reflection(n), 0)] if symmetric else []
+    basis = SpinBasis(n, hamming_weight, None, syms)
+    sites = [list(e) for e in chain_edges(n)]
+    return Operator.from_expressions(
+        basis,
+        [("σˣ₀ σˣ₁", sites), ("σʸ₀ σʸ₁", sites),
+         (f"{float(delta)!r} × σᶻ₀ σᶻ₁", sites)],
+        name=f"XXZ(Δ={delta}) chain",
+    )
+
+
+def transverse_field_ising_chain(n: int, h: float = 1.0) -> Operator:
+    """TFIM ring: −Σ σᶻσᶻ − h·Σ σˣ (no hamming sector — σˣ flips spins)."""
+    sites = [list(e) for e in chain_edges(n)]
+    fields = [[i] for i in range(n)]
+    basis = SpinBasis(n)          # full 2^n space
+    return Operator.from_expressions(
+        basis,
+        [("-1.0 × σᶻ₀ σᶻ₁", sites), (f"{-float(h)!r} × σˣ₀", fields)],
+        name=f"TFIM(h={h}) chain",
+    )
+
+
+def square_diagonal_edges(nx: int, ny: int) -> List[Tuple[int, int]]:
+    """Next-nearest-neighbour (diagonal) bonds of the periodic square lattice."""
+    def idx(x, y):
+        return (y % ny) * nx + (x % nx)
+
+    edges = []
+    for y in range(ny):
+        for x in range(nx):
+            edges.append((idx(x, y), idx(x + 1, y + 1)))
+            edges.append((idx(x + 1, y), idx(x, y + 1)))
+    return sorted(tuple(sorted(e)) for e in edges)
+
+
+def j1j2_square(nx: int, ny: int, j2: float = 0.5) -> Operator:
+    """Frustrated J1–J2 Heisenberg on the periodic square lattice."""
+    n = nx * ny
+    basis = SpinBasis(n, n // 2)
+    return heisenberg_from_edges(
+        basis, square_edges(nx, ny),
+        extra=[(j2, square_diagonal_edges(nx, ny))])
