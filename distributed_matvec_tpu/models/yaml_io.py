@@ -13,7 +13,7 @@ from typing import List, Optional
 
 import yaml
 
-from .basis import SpinBasis
+from .basis import SpinBasis, SpinfulFermionBasis, SpinlessFermionBasis
 from .operator import Operator
 
 __all__ = ["Config", "load_config_from_yaml", "basis_from_dict", "operator_from_dict"]
@@ -27,6 +27,19 @@ class Config:
 
 
 def basis_from_dict(d: dict) -> SpinBasis:
+    """Build a basis from a config dict; dispatches on ``particle``
+    (``spin``/``spin-1/2`` default | ``spinless_fermion`` |
+    ``spinful_fermion``, hyphen or underscore) like the reference's basis
+    JSON (FFI.chpl:85-88; the shipped data/*.yaml write ``spin-1/2``)."""
+    particle = d.get("particle", "spin").replace("-", "_")
+    if particle == "spinless_fermion":
+        return SpinlessFermionBasis(d["number_sites"],
+                                    d.get("number_particles"))
+    if particle == "spinful_fermion":
+        return SpinfulFermionBasis(d["number_sites"], d.get("number_up"),
+                                   d.get("number_down"))
+    if particle not in ("spin", "spin_1/2"):
+        raise ValueError(f"unknown particle type {particle!r}")
     return SpinBasis(
         number_spins=d["number_spins"],
         hamming_weight=d.get("hamming_weight"),
