@@ -162,10 +162,16 @@ def main(argv=None):
         print(f"  E[{i}] = {w:.12f}   residual {r:.2e}")
 
     if args.observables and cfg.observables and evec_rows is not None:
+        # ⟨ψ₀|O|ψ₀⟩ per observable, printed and saved under /observables —
+        # the output group the reference driver creates (Diagonalize.chpl:276-279)
+        from distributed_matvec_tpu.io.hdf5 import save_observables
+
         psi = evec_rows[0]
-        for obs in cfg.observables:
-            val = np.vdot(psi, obs.matvec_host(psi))
-            print(f"  <{obs.name or 'O'}> = {val.real:.12f}")
+        values = [(obs.name or f"observable_{k}",
+                   np.vdot(psi, obs.matvec_host(psi)).real)
+                  for k, obs in enumerate(cfg.observables)]
+        for name, val in save_observables(out, values).items():
+            print(f"  <{name}> = {val:.12f}")
 
     timer.report()
     return 0

@@ -13,6 +13,7 @@ Replaces the reference's ``MyHDF5.chpl`` (direct C-HDF5 hyperslab machinery,
                                                       transposed layout,
                                                       input_for_matvec.py:43-46)
       /hamiltonian/residuals        f64 [k]
+      /observables/<name>           f64 scalar ⟨ψ₀|O|ψ₀⟩ per YAML observable
   * golden-file layout (input_for_matvec.py:28-46): /representatives, /x, /y.
 
 On a sharded run, hashed-layout arrays are converted to block (global sorted)
@@ -33,6 +34,7 @@ __all__ = [
     "load_eigen",
     "save_golden",
     "load_golden",
+    "save_observables",
     "make_or_restore_representatives",
 ]
 
@@ -126,6 +128,28 @@ def load_eigen(path: str):
             g["eigenvectors"][...] if "eigenvectors" in g else None,
             g["residuals"][...] if "residuals" in g else None,
         )
+
+
+def save_observables(path: str, values) -> dict:
+    """Write ⟨ψ|O|ψ⟩ scalars under /observables (Diagonalize.chpl:276-279's
+    output group).  ``values`` is a sequence of (name, value); duplicate
+    names are disambiguated with a numeric suffix so no result is silently
+    dropped.  Returns the name → value mapping actually written."""
+    h5 = _h5py()
+    written = {}
+    for name, val in values:
+        key, k = name, 2
+        while key in written:
+            key = f"{name}_{k}"
+            k += 1
+        written[key] = float(val)
+    with h5.File(path, "a") as f:
+        g = f.require_group("observables")
+        for key, val in written.items():
+            if key in g:
+                del g[key]
+            g.create_dataset(key, data=val)
+    return written
 
 
 def save_golden(path: str, representatives: np.ndarray, x: np.ndarray,

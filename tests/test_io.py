@@ -136,3 +136,30 @@ def test_diagonalize_cli_distributed(tmp_path):
     v = np.asarray(V[0])
     r_norm = np.linalg.norm(cfg.hamiltonian.matvec_host(v) - w[0] * v)
     assert r_norm < 1e-7, r_norm
+
+
+def test_diagonalize_cli_observables(tmp_path):
+    """--observables computes ⟨ψ₀|O|ψ₀⟩ and saves it under /observables.
+    For the ring ground state the total magnetization Σσᶻ is exactly 0."""
+    import subprocess
+    import sys
+
+    yaml_path = str(tmp_path / "m.yaml")
+    with open(yaml_path, "w") as f:
+        f.write(_RING10_YAML)
+        f.write("""
+observables:
+  - name: total_sz
+    terms:
+      - {expression: "σᶻ₀", sites: [[0],[1],[2],[3],[4],[5],[6],[7],[8],[9]]}
+""")
+    out = str(tmp_path / "m.h5")
+    r = subprocess.run([sys.executable, _APP, yaml_path, "-o", out,
+                        "-k", "1", "--observables"],
+                       capture_output=True, text=True, env=_cli_env(),
+                       timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "<total_sz>" in r.stdout
+    with h5py.File(out, "r") as f:
+        val = float(f["observables/total_sz"][()])
+    assert abs(val) < 1e-9, val
