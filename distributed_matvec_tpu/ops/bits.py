@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["popcount64", "hash64", "shard_index", "state_index_sorted",
-           "sign_from_parity", "build_sorted_lookup", "state_index_bucketed"]
+           "sign_from_parity", "choose_dir_bits", "build_sorted_lookup",
+           "state_index_bucketed"]
 
 _U = jnp.uint64
 
@@ -65,8 +67,6 @@ def choose_dir_bits(n: int, n_bits: int, max_dir_bits: int = 24) -> int:
     """Directory width for an ``n``-entry basis over ``n_bits``-bit states:
     ~1-entry average buckets, capped by the state width and a memory bound
     (2^24 × i32 = 64 MB)."""
-    import numpy as np
-
     return min(max(n_bits, 1),
                max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1), max_dir_bits)
 
@@ -88,8 +88,6 @@ def build_sorted_lookup(reps, n_bits: int, max_dir_bits: int = 24,
     — arrays are NumPy (callers ship them to devices as jit arguments),
     ``shift``/``probes`` are Python ints to close over statically.
     """
-    import numpy as np
-
     reps = np.asarray(reps, dtype=np.uint64)
     n = int(reps.size)
     b = dir_bits if dir_bits is not None \

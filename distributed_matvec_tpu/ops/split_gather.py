@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from ..utils.config import get_config
 
-__all__ = ["split_gather_enabled", "split_parts", "join_parts", "num_parts",
+__all__ = ["split_gather_enabled", "split_parts", "join_parts",
            "prep_gather"]
 
 
@@ -63,10 +63,6 @@ def prep_gather(x, dtype, enabled: bool):
         return lambda i: x[i]
     xs = split_parts(x)
     return lambda i: join_parts(xs[i], dtype)
-
-
-def num_parts(dtype) -> int:
-    return 6 if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating) else 3
 
 
 def _split3(x):

@@ -33,7 +33,6 @@ and checked on first application.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Optional, Tuple
 
