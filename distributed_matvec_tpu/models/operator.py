@@ -159,6 +159,51 @@ class Operator:
                 all_terms.extend(sym.instantiate(row))
         return Operator(basis, all_terms, name=name)
 
+    # -- operator algebra (front-end parity with the reference's expression
+    #    algebra in lattice-symmetries: H = a*op1 + op2 - op3) ---------------
+
+    def _require_same_basis(self, other: "Operator") -> None:
+        if other.basis is not self.basis:
+            raise ValueError("operators act on different bases")
+
+    def __add__(self, other: "Operator") -> "Operator":
+        if not isinstance(other, Operator):
+            return NotImplemented
+        self._require_same_basis(other)
+        name = f"{self.name} + {other.name}".strip(" +") if \
+            (self.name or other.name) else ""
+        return Operator(self.basis, list(self.terms) + list(other.terms),
+                        name=name)
+
+    def __sub__(self, other: "Operator") -> "Operator":
+        if not isinstance(other, Operator):
+            return NotImplemented
+        self._require_same_basis(other)
+        from dataclasses import replace
+
+        neg = [replace(t, v=-t.v) for t in other.terms]
+        name = f"{self.name} - {other.name}".strip(" -") if \
+            (self.name or other.name) else ""
+        return Operator(self.basis, list(self.terms) + neg, name=name)
+
+    def __neg__(self) -> "Operator":
+        op = (-1.0) * self
+        op.name = f"-{self.name}" if self.name else ""
+        return op
+
+    def __mul__(self, scalar) -> "Operator":
+        import numbers
+
+        if not isinstance(scalar, numbers.Number):
+            return NotImplemented
+        from dataclasses import replace
+
+        terms = [replace(t, v=t.v * scalar) for t in self.terms]
+        name = f"{scalar}·{self.name}" if self.name else ""
+        return Operator(self.basis, terms, name=name)
+
+    __rmul__ = __mul__
+
     # -- properties (reference API parity) -----------------------------------
 
     @property
