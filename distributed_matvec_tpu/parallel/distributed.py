@@ -62,7 +62,7 @@ from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import SENTINEL_STATE, choose_ell_split
+from .engine import SENTINEL_STATE, check_complex_backend, choose_ell_split
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
 
@@ -106,6 +106,10 @@ class DistributedEngine:
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.n_devices = self.mesh.devices.size
         self.real = operator.effective_is_real
+        # guard against the platform the MESH runs on (a CPU mesh on a TPU
+        # host is fine — it never touches the hanging TPU compiler)
+        check_complex_backend(self.real,
+                              platform=self.mesh.devices.flat[0].platform)
         self._dtype = jnp.float64 if self.real else jnp.complex128
         self.timer = TreeTimer("DistributedEngine")
 

@@ -91,6 +91,33 @@ def choose_ell_split(hist: np.ndarray, n_rows: int, T: int,
     return T0, S, Tmax
 
 
+def check_complex_backend(effective_is_real: bool,
+                          platform: str | None = None) -> None:
+    """Refuse complex128 engines on a TPU backend unless overridden.
+
+    Measured on this platform: any complex128 program hangs the TPU
+    compiler indefinitely (f64 and c64 compile in <1 s; even
+    ``(a·conj(a)).real.sum()`` on 128 elements never returns).  Momentum
+    sectors with complex characters therefore cannot run on the chip yet —
+    fail loudly with the workarounds instead of hanging for hours.  The
+    ``allow_complex_on_tpu`` knob bypasses the guard for TPU stacks whose
+    compiler handles c128.
+    """
+    if effective_is_real:
+        return
+    if (platform or jax.default_backend()) != "tpu":
+        return
+    if get_config().allow_complex_on_tpu:
+        return
+    raise RuntimeError(
+        "complex128 engines are disabled on the TPU backend: this "
+        "platform's compiler hangs on any complex128 program. Options: "
+        "run the momentum sector on CPU (JAX_PLATFORMS=cpu), pick a real "
+        "sector (0 or half-period — see Operator.effective_is_real), or "
+        "set allow_complex_on_tpu=True if your TPU stack compiles c128."
+    )
+
+
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):
     pad = n_pad - reps.size
     alphas = np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
@@ -128,6 +155,7 @@ class LocalEngine:
         self.operator = operator
         self.mode = mode
         self.real = operator.effective_is_real
+        check_complex_backend(self.real)
         self._dtype = jnp.float64 if self.real else jnp.complex128
         n = basis.number_states
         b = min(batch_size or cfg.matvec_batch_size, max(n, 1))

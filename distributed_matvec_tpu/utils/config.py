@@ -39,6 +39,10 @@ class RuntimeConfig:
     matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
+    allow_complex_on_tpu: bool = False     # override the c128-on-TPU guard
+    #   (measured here: ANY complex128 program hangs this platform's TPU
+    #    compiler indefinitely while f64 and c64 compile in <1 s; engines
+    #    refuse complex sectors on the TPU backend unless this is set)
 
 
 

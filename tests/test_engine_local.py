@@ -189,3 +189,27 @@ def test_split_gather_matches_plain(rng):
                 update_config(split_gather="auto")
             np.testing.assert_allclose(y, y_ref, atol=1e-14, rtol=1e-14)
             np.testing.assert_allclose(Y, Y_ref, atol=1e-14, rtol=1e-14)
+
+
+def test_complex_on_tpu_guard(monkeypatch):
+    """Complex sectors must fail LOUDLY on a TPU backend (this platform's
+    compiler hangs on any complex128 program) — not hang for hours; the
+    allow_complex_on_tpu knob bypasses the guard."""
+    import jax
+
+    from distributed_matvec_tpu.parallel.engine import check_complex_backend
+    from distributed_matvec_tpu.utils.config import update_config
+
+    from distributed_matvec_tpu.utils.config import get_config
+
+    check_complex_backend(True)                  # real: never gated
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(RuntimeError, match="complex128.*TPU"):
+        check_complex_backend(False)
+    check_complex_backend(False, platform="cpu")  # CPU mesh on TPU host: ok
+    prev = get_config().allow_complex_on_tpu
+    update_config(allow_complex_on_tpu=True)
+    try:
+        check_complex_backend(False)             # override allows
+    finally:
+        update_config(allow_complex_on_tpu=prev)
