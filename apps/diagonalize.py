@@ -118,6 +118,11 @@ def main(argv=None):
 
     with timer.scope("solve"), maybe_profile():
         t0 = time.perf_counter()
+        if args.block and getattr(eng, "pair", False):
+            print("--block (LOBPCG) does not support pair-form complex "
+                  "sectors; use Lanczos (default) or run on CPU "
+                  "(JAX_PLATFORMS=cpu)", file=sys.stderr)
+            return 2
         if args.block:
             evals, evecs_cols, iters = lobpcg(
                 eng.matvec, n, k=args.num_evals, tol=args.tol,
@@ -146,11 +151,17 @@ def main(argv=None):
 
     evec_rows = None
     if evecs is not None and not args.no_eigenvectors:
+        is_pair = bool(getattr(eng, "pair", False))
+        hashed_ndim = 3 if is_pair else 2   # [D, M(, 2)] hashed layout
         rows = []
         for v in evecs[: args.num_evals]:
             v = np.asarray(v)
-            if hasattr(eng, "from_hashed") and v.ndim == 2:
+            if hasattr(eng, "from_hashed") and v.ndim == hashed_ndim:
                 v = eng.from_hashed(v)   # hashed → block order for I/O
+            if is_pair:                  # (re, im) pair → complex for I/O
+                from distributed_matvec_tpu.ops.kernels import (
+                    complex_from_pair)
+                v = complex_from_pair(v)
             rows.append(v)
         evec_rows = np.stack(rows)
 

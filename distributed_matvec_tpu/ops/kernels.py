@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models.symmetry import _CHAR_TOL
 from .bits import popcount64, sign_from_parity
 
 __all__ = [
@@ -43,9 +44,57 @@ __all__ = [
     "gather_coefficients",
     "mask_structure",
     "state_info",
+    "cmul_pair",
+    "conj_pair",
+    "pair_from_complex",
+    "complex_from_pair",
 ]
 
 _U = jnp.uint64
+
+# Zero-norm snap tolerance for the stabilizer character sum, shared with
+# the host enumeration (models.symmetry._CHAR_TOL): sectors whose character
+# sum cancels exactly (e.g. 1 + 2·cos(2π/3)) leave ~1e-16 of floating-point
+# residue, which must read as "state not in sector" on device exactly as it
+# does on the host, or the engine build flags phantom out-of-basis targets.
+_NORM2_TOL = _CHAR_TOL
+
+
+# ---------------------------------------------------------------------------
+# (re, im) pair representation of complex values
+#
+# TPU has no native complex128 (and this platform's compiler hangs on any
+# c128 program — see parallel.engine.check_complex_backend).  Complex-
+# character momentum sectors therefore run in *pair* form: every complex
+# array carries a trailing axis of length 2 holding (re, im) as f64.  A
+# Hermitian H on C^N is exactly a real-symmetric operator on R^{2N}
+# ([[Hr, −Hi], [Hi, Hr]]), so the whole engine/solver stack stays in f64.
+# ---------------------------------------------------------------------------
+
+
+def cmul_pair(c: jax.Array, g: jax.Array) -> jax.Array:
+    """Complex multiply on (re, im) pairs: ``[..., 2] × [..., 2] → [..., 2]``."""
+    cr, ci = c[..., 0], c[..., 1]
+    gr, gi = g[..., 0], g[..., 1]
+    return jnp.stack([cr * gr - ci * gi, cr * gi + ci * gr], axis=-1)
+
+
+def conj_pair(c: jax.Array) -> jax.Array:
+    """Complex conjugate on (re, im) pairs (negates the im slot)."""
+    return jnp.stack([c[..., 0], -c[..., 1]], axis=-1)
+
+
+def pair_from_complex(z) -> np.ndarray:
+    """Host-side complex ``[...]`` → f64 pair ``[..., 2]`` (NumPy)."""
+    z = np.asarray(z)
+    return np.stack([z.real.astype(np.float64),
+                     z.imag.astype(np.float64)], axis=-1)
+
+
+def complex_from_pair(p) -> np.ndarray:
+    """Host-side f64 pair ``[..., 2]`` → complex128 ``[...]`` (NumPy)."""
+    p = np.asarray(p)
+    return p[..., 0] + 1j * p[..., 1]
 
 
 class DiagKernelTables(NamedTuple):
@@ -57,7 +106,7 @@ class DiagKernelTables(NamedTuple):
 
 class OffDiagKernelTables(NamedTuple):
     x: jax.Array  # [T] u64 flip mask per group
-    v: jax.Array  # [T,K] f64 or c128 inner amplitudes (0 = padding)
+    v: jax.Array  # [T,K] f64/c128 — or [T,K,2] f64 (re, im) pair form
     s: jax.Array  # [T,K] u64
     m: jax.Array  # [T,K] u64
     r: jax.Array  # [T,K] u64
@@ -81,7 +130,7 @@ class GroupTables(NamedTuple):
     c_m: jax.Array        # [J,Sc] u64
     c_xor: jax.Array      # [J] u64 — spin-inversion xor per coset rep
     elem: jax.Array       # [J,P] i32 — canonical element index of h^k·c_j
-    char_conj: jax.Array  # [G] f64 or c128 — χ*(g), consumed multiplicatively
+    char_conj: jax.Array  # [G] f64/c128 — or [G,2] f64 pair form — χ*(g)
     char_real: jax.Array  # [G] f64 — Re χ(g) for stabilizer norm sums
 
 
@@ -91,10 +140,15 @@ class OperatorTables(NamedTuple):
     group: Optional[GroupTables]  # None when the basis needs no projection
 
 
-def device_tables(op) -> OperatorTables:
-    """Compile an :class:`Operator` into device-resident kernel tables."""
+def device_tables(op, pair: bool = False) -> OperatorTables:
+    """Compile an :class:`Operator` into device-resident kernel tables.
+
+    ``pair=True`` stores complex amplitudes/characters in (re, im) f64 pair
+    form (trailing axis 2) instead of complex128 — the TPU-safe layout.  It
+    is a no-op for operators that are effectively real.
+    """
     real = op.effective_is_real
-    amp_dtype = jnp.float64 if real else jnp.complex128
+    pair = pair and not real
     dt, ot = op.diag_table, op.off_diag_table
     assert np.abs(dt.v.imag).max(initial=0.0) < 1e-12, "non-real diagonal"
     diag = DiagKernelTables(
@@ -103,7 +157,9 @@ def device_tables(op) -> OperatorTables:
         m=jnp.asarray(dt.m),
         r=jnp.asarray(dt.r),
     )
-    if not real:
+    if pair:
+        off_v = jnp.asarray(pair_from_complex(ot.v))
+    elif not real:
         off_v = jnp.asarray(ot.v, jnp.complex128)
     else:
         assert np.abs(ot.v.imag).max(initial=0.0) < 1e-12
@@ -128,14 +184,18 @@ def device_tables(op) -> OperatorTables:
             c_m[j, : m_j.size] = m_j
             c_xor[j] = xor_j
         cc = np.conj(g.characters)
+        if pair:
+            char_conj = jnp.asarray(pair_from_complex(cc))
+        else:
+            char_conj = jnp.asarray(cc.real if real else cc,
+                                    jnp.float64 if real else jnp.complex128)
         group = GroupTables(
             h_ls=jnp.asarray(h_ls), h_rs=jnp.asarray(h_rs),
             h_m=jnp.asarray(h_m),
             c_ls=jnp.asarray(c_ls), c_rs=jnp.asarray(c_rs),
             c_m=jnp.asarray(c_m), c_xor=jnp.asarray(c_xor),
             elem=jnp.asarray(np.stack(elem_idx)),
-            char_conj=jnp.asarray(cc.real if real else cc,
-                                  jnp.float64 if real else jnp.complex128),
+            char_conj=char_conj,
             char_real=jnp.asarray(g.characters.real, jnp.float64),
         )
     return OperatorTables(diag=diag, off=off, group=group)
@@ -155,12 +215,18 @@ def apply_off_diag(t: OffDiagKernelTables, alphas: jax.Array):
     """H's off-diagonal action: [B] u64 → betas [B,T] u64, amps [B,T].
 
     amps[i,j] = Σ_k v[j,k]·(−1)^pc(α_i∧s)·[α_i∧m==r]; betas[i,j] = α_i⊕x[j].
+    Pair-form tables (``v`` of shape [T,K,2]) yield pair amps [B,T,2].
     """
     betas = alphas[:, None] ^ t.x[None, :]
     a = alphas[:, None, None]
     sign = sign_from_parity(a & t.s[None])
     ok = (a & t.m[None]) == t.r[None]
-    amps = jnp.sum(t.v[None] * sign * ok, axis=2)
+    if t.v.ndim == 3:  # pair form
+        w = sign * ok                                      # [B,T,K] f64
+        amps = jnp.stack([jnp.sum(t.v[None, ..., 0] * w, axis=2),
+                          jnp.sum(t.v[None, ..., 1] * w, axis=2)], axis=-1)
+    else:
+        amps = jnp.sum(t.v[None] * sign * ok, axis=2)
     return betas, amps
 
 
@@ -175,12 +241,17 @@ def gather_coefficients(t: OperatorTables, alphas: jax.Array,
     [B,T] amp).  Zero amplitude marks "no matrix element" (padding included).
     """
     betas, amps = apply_off_diag(t.off, alphas)  # amps = ⟨β|H|α⟩
+    pair = amps.ndim == 3
     if t.group is not None:
         rep_b, char_conj_b, norm_b = state_info(t.group, betas)
-        amps = jnp.conj(amps * char_conj_b) * (norm_b / norms_alpha[:, None])
+        ratio = norm_b / norms_alpha[:, None]
+        if pair:
+            amps = conj_pair(cmul_pair(amps, char_conj_b)) * ratio[..., None]
+        else:
+            amps = jnp.conj(amps * char_conj_b) * ratio
         betas = rep_b
     else:
-        amps = jnp.conj(amps)
+        amps = conj_pair(amps) if pair else jnp.conj(amps)
     return betas, amps
 
 
@@ -195,13 +266,16 @@ def mask_structure(coeff: jax.Array, idx: jax.Array, found: jax.Array,
     ``invalid`` (the halt condition of DistributedMatrixVector.chpl:113-118).
     Counting structure (coeff ≠ 0) rather than amplitude·x keeps the result
     independent of x's zero pattern, so a first-call check is valid for every
-    subsequent application.
+    subsequent application.  Pair-form coefficients (trailing axis 2) count
+    as nonzero when either slot is.
     """
     vr = valid_row[:, None]
-    nz = (coeff != 0) & vr
+    pair = coeff.ndim == idx.ndim + 1
+    live = (coeff != 0).any(axis=-1) if pair else (coeff != 0)
+    nz = live & vr
     invalid = jnp.sum(nz & ~found)
     nz = nz & found
-    coeff = jnp.where(nz, coeff, 0)
+    coeff = jnp.where(nz[..., None] if pair else nz, coeff, 0)
     idx = jnp.where(nz, idx, 0)
     return idx, coeff, invalid
 
@@ -214,6 +288,11 @@ def state_info(g: GroupTables, states: jax.Array):
       rep(σ)  = min_g g·σ
       char(σ) = χ*(g_first-achieving-min)
       norm(σ) = sqrt((1/|G|)·Σ_{g·σ=σ} Re χ(g))   (0 ⇒ not in the sector)
+
+    The scan carry tracks the *index* of the winning group element (i32) —
+    never a character value — so the loop body is pure integer/f64 work even
+    for complex-character sectors; ``χ*`` is one ``[G]``-table gather at the
+    end (``char_conj`` rows may be scalars or (re, im) pairs).
     """
     G = g.char_conj.shape[0]
     J, P = g.elem.shape
@@ -232,31 +311,36 @@ def state_info(g: GroupTables, states: jax.Array):
         return acc
 
     def update(carry, y, gi):
-        best, char, stab = carry
+        best, gidx, stab = carry
         better = y < best
         best = jnp.where(better, y, best)
-        char = jnp.where(better, g.char_conj[gi], char)
+        gidx = jnp.where(better, gi, gidx)
         stab = stab + jnp.where(y == flat, g.char_real[gi], 0.0)
-        return best, char, stab
+        return best, gidx, stab
 
-    # Zero with the same device-varying type as the input (so the carry is
+    # Zeros with the same device-varying type as the input (so the carry is
     # stable when this runs inside shard_map; XLA folds the xor away).
     zero = (flat ^ flat).astype(jnp.float64)
-    carry = (flat + jnp.uint64(0),  # identity is elem[0,0]; re-updated below
-             g.char_conj[0] + zero.astype(g.char_conj.dtype), zero)
+    izero = (flat ^ flat).astype(jnp.int32)
+    carry = (flat + jnp.uint64(0),  # identity (elem index 0); re-updated below
+             izero, zero)
     for j in range(J):  # few cosets — unrolled
         z = apply_coset_rep(j, flat)
         carry = update(carry, z, g.elem[j, 0])
 
         def body(k, c):
-            best, char, stab, z = c
+            best, gidx, stab, z = c
             z = advance(z)
-            best, char, stab = update((best, char, stab), z, g.elem[j, k])
-            return best, char, stab, z
+            best, gidx, stab = update((best, gidx, stab), z, g.elem[j, k])
+            return best, gidx, stab, z
 
-        best, char, stab, _ = jax.lax.fori_loop(1, P, body, carry + (z,))
-        carry = (best, char, stab)
-    best, char, stab = carry
-    norm = jnp.sqrt(jnp.maximum(stab, 0.0) / G)
+        best, gidx, stab, _ = jax.lax.fori_loop(1, P, body, carry + (z,))
+        carry = (best, gidx, stab)
+    best, gidx, stab = carry
+    char = g.char_conj[gidx]
+    norm2 = stab / G
+    norm = jnp.where(norm2 > _NORM2_TOL, jnp.sqrt(jnp.maximum(norm2, 0.0)),
+                     0.0)
     shape = states.shape
-    return best.reshape(shape), char.reshape(shape), norm.reshape(shape)
+    char_shape = shape + g.char_conj.shape[1:]
+    return best.reshape(shape), char.reshape(char_shape), norm.reshape(shape)

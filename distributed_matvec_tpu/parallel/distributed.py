@@ -62,7 +62,8 @@ from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import SENTINEL_STATE, check_complex_backend, choose_ell_split
+from .engine import (SENTINEL_STATE, check_complex_backend, choose_ell_split,
+                     use_pair_complex)
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
 
@@ -71,6 +72,11 @@ __all__ = ["DistributedEngine"]
 
 def _round_up(n: int, b: int) -> int:
     return max(((n + b - 1) // b) * b, b)
+
+
+def _pspec(ndim: int) -> P:
+    """PartitionSpec splitting axis 0 over the mesh, replicating the rest."""
+    return P(SHARD_AXIS, *([None] * (ndim - 1)))
 
 
 class DistributedEngine:
@@ -106,11 +112,16 @@ class DistributedEngine:
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.n_devices = self.mesh.devices.size
         self.real = operator.effective_is_real
-        # guard against the platform the MESH runs on (a CPU mesh on a TPU
-        # host is fine — it never touches the hanging TPU compiler)
-        check_complex_backend(self.real,
-                              platform=self.mesh.devices.flat[0].platform)
-        self._dtype = jnp.float64 if self.real else jnp.complex128
+        # Complex sectors: (re, im)-f64 pair form on a TPU mesh (vectors get
+        # a trailing axis of 2), native c128 elsewhere.  Both are decided by
+        # the platform the MESH runs on (a CPU mesh on a TPU host never
+        # touches the hanging TPU compiler).
+        platform = self.mesh.devices.flat[0].platform
+        self.pair = (not self.real) and use_pair_complex(platform)
+        if not self.pair:
+            check_complex_backend(self.real, platform=platform)
+        self._dtype = jnp.float64 if (self.real or self.pair) \
+            else jnp.complex128
         self.timer = TreeTimer("DistributedEngine")
 
         reps, norms = basis.representatives, basis.norms
@@ -123,7 +134,7 @@ class DistributedEngine:
         # Per-shard sorted representative/norm arrays [D, M] (SENTINEL pad).
         alphas = self.layout.to_hashed(reps, fill=SENTINEL_STATE)
         nrm = self.layout.to_hashed(norms, fill=1.0)
-        self.tables = K.device_tables(operator)
+        self.tables = K.device_tables(operator, pair=self.pair)
         self.num_terms = int(self.tables.off.x.shape[0])
 
         self._sh1 = shard_spec(self.mesh, 2)
@@ -206,6 +217,9 @@ class DistributedEngine:
                                            jnp.asarray(norms_h[d]))
             betas = np.asarray(betas_d)
             cf = np.asarray(coeff_d)
+            if self.pair:
+                # the plan is host-side math — complex128 is fine here
+                cf = K.complex_from_pair(cf)
             owner = (hash64_host(betas) % np.uint64(D)).astype(np.int32) \
                 if D > 1 else np.zeros(betas.shape, np.int32)
             idx = np.zeros(betas.shape, np.int64)
@@ -274,14 +288,24 @@ class DistributedEngine:
 
         g_idx, coeffs, tail = self._split_tables(g_idx, coeffs)
         sh3 = shard_spec(self.mesh, 3)
-        # Transposed [T0, M] per shard (see LocalEngine layout note).
+        # Transposed [T0, M(, 2)] per shard (see LocalEngine layout note);
+        # pair mode uploads (re, im)-f64 instead of c128.
+        cf_up = np.swapaxes(coeffs, 1, 2)
+        if self.pair:
+            cf_up = K.pair_from_complex(cf_up)
         self._ell_idx = jax.device_put(
             jnp.asarray(np.swapaxes(g_idx, 1, 2)), sh3)
         self._ell_coeff = jax.device_put(
-            jnp.asarray(np.swapaxes(coeffs, 1, 2)), sh3)
-        self._ell_tail = None if tail is None else tuple(
-            jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
-            for a in tail)
+            jnp.asarray(cf_up), shard_spec(self.mesh, cf_up.ndim))
+        if tail is None:
+            self._ell_tail = None
+        else:
+            rows_t, idx_t, cf_t = tail
+            if self.pair:
+                cf_t = K.pair_from_complex(cf_t)
+            self._ell_tail = tuple(
+                jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
+                for a in (rows_t, idx_t, cf_t))
         self._qin = jax.device_put(jnp.asarray(qin), sh3)
 
     def _split_tables(self, g_idx: np.ndarray, coeffs: np.ndarray):
@@ -331,13 +355,15 @@ class DistributedEngine:
         dtype = self._dtype
         has_tail = self._ell_tail is not None
         use_sg = split_gather_enabled()
+        is_pair = self.pair
+        nd_base = 2 if is_pair else 1   # ndim of one unbatched local vector
 
         def shard_body(x, qin, gidx, coeff, diag, tail):
             x, qin, gidx, coeff, diag = (
                 a[0] for a in (x, qin, gidx, coeff, diag))
-            batched = x.ndim == 2
+            batched = x.ndim == nd_base + 1
             if D > 1:
-                S = x[qin]                      # [D, C(, k)]
+                S = x[qin]                      # [D, C] + x.shape[1:]
                 R = jax.lax.all_to_all(S, SHARD_AXIS, 0, 0, tiled=True)
                 xx = jnp.concatenate(
                     [x, R.reshape((D * C,) + x.shape[1:])], axis=0)
@@ -345,38 +371,37 @@ class DistributedEngine:
                 xx = x
             gx = prep_gather(xx, dtype, use_sg)
 
+            def contrib(c, g):
+                if is_pair:
+                    return K.cmul_pair(c[:, None, :] if batched else c, g)
+                return (c[:, None] if batched else c) * g
+
             def terms(y, gidx, coeff, width):
                 for t in range(width):
-                    c = coeff[t]
-                    y = y + (c[:, None] if batched else c) * gx(gidx[t])
+                    y = y + contrib(coeff[t], gx(gidx[t]))
                 return y
 
-            y = (diag[:, None] if batched else diag).astype(dtype) * x
-            y = terms(y, gidx, coeff, T0)
+            d = diag.reshape(diag.shape + (1,) * (x.ndim - 1)).astype(dtype)
+            y = terms(d * x, gidx, coeff, T0)
             if has_tail:
                 rows, idx_t, cf_t = (a[0] for a in tail)
-                zshape = (rows.shape[0], x.shape[1]) if batched \
-                    else rows.shape
+                zshape = rows.shape + x.shape[1:]
                 acc = terms(jnp.zeros(zshape, dtype), idx_t, cf_t,
                             idx_t.shape[0])
                 y = y.at[rows].add(acc, mode="drop")
             return y[None]
 
-        spec1 = P(SHARD_AXIS, None)
-        spec2 = P(SHARD_AXIS, None, None)
-        spec3 = P(SHARD_AXIS, None, None)
-        tail_specs = (spec1, spec3, spec3)
         mesh = self.mesh
 
         def apply_fn(x, operands):
             qin, gidx, coeff, diag, tail = operands
-            batched = x.ndim == 3
-            xspec = spec2 if batched else spec1
+            tail_specs = tuple(_pspec(a.ndim) for a in tail) if has_tail \
+                else P()
             f = jax.shard_map(
                 shard_body, mesh=mesh,
-                in_specs=(xspec, spec3, spec3, spec3, spec1,
-                          tail_specs if has_tail else P()),
-                out_specs=xspec,
+                in_specs=(_pspec(x.ndim), _pspec(qin.ndim), _pspec(gidx.ndim),
+                          _pspec(coeff.ndim), _pspec(diag.ndim), tail_specs),
+                out_specs=_pspec(x.ndim),
             )
             y = f(x.astype(dtype), qin, gidx, coeff, diag, tail)
             return y, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64)
@@ -410,12 +435,14 @@ class DistributedEngine:
         Mp = nchunks * B
         dtype = self._dtype
         lk_shift, lk_probes = self._lk_shift, self._lk_probes
+        is_pair = self.pair
+        ptail = (2,) if is_pair else ()   # trailing (re, im) axis in pair mode
 
         def shard_body(x, alphas, norms, tables, lk_pair, lk_dir):
             x, alphas, norms = x[0], alphas[0], norms[0]
             lk_pair, lk_dir = lk_pair[0], lk_dir[0]
             # pad local arrays to a whole number of chunks
-            xp = jnp.pad(x, (0, Mp - M))
+            xp = jnp.pad(x, ((0, Mp - M),) + ((0, 0),) * (x.ndim - 1))
             ap = jnp.pad(alphas, (0, Mp - M),
                          constant_values=SENTINEL_STATE)
             np_ = jnp.pad(norms, (0, Mp - M), constant_values=1.0)
@@ -429,10 +456,16 @@ class DistributedEngine:
                 # x's zero pattern, so the overflow/invalid counters checked
                 # on the first call hold for every later x.
                 valid_row = (a_c != SENTINEL_STATE)[:, None]
-                nz = (gcoeff != 0) & valid_row
-                amps = jnp.where(nz, jnp.conj(gcoeff) * x_c[:, None], 0)
+                if is_pair:
+                    nz = (gcoeff != 0).any(axis=-1) & valid_row
+                    amps = jnp.where(
+                        nz[..., None],
+                        K.cmul_pair(K.conj_pair(gcoeff), x_c[:, None, :]), 0)
+                else:
+                    nz = (gcoeff != 0) & valid_row
+                    amps = jnp.where(nz, jnp.conj(gcoeff) * x_c[:, None], 0)
                 flat_b = betas.reshape(-1)
-                flat_a = amps.reshape(-1)
+                flat_a = amps.reshape((-1,) + ptail)
                 live = nz.reshape(-1)
                 owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
                     if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
@@ -448,15 +481,16 @@ class DistributedEngine:
                 dest = jnp.where(in_cap, key_s * Cap + pos, D * Cap)
                 send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
                     b_s, mode="drop")
-                send_a = jnp.zeros(D * Cap, dtype).at[dest].set(
+                send_a = jnp.zeros((D * Cap,) + ptail, dtype).at[dest].set(
                     a_s, mode="drop")
                 if D > 1:
                     recv_b = jax.lax.all_to_all(
                         send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
                     ).reshape(-1)
                     recv_a = jax.lax.all_to_all(
-                        send_a.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
-                    ).reshape(-1)
+                        send_a.reshape((D, Cap) + ptail), SHARD_AXIS, 0, 0,
+                        tiled=True
+                    ).reshape((-1,) + ptail)
                 else:
                     recv_b, recv_a = send_b, send_a
                 idx, found = state_index_bucketed(
@@ -468,54 +502,55 @@ class DistributedEngine:
                 okc = found & live_r
                 invalid = invalid + jnp.sum(live_r & ~found)
                 y = y + jax.ops.segment_sum(
-                    jnp.where(okc, recv_a, 0), jnp.where(okc, idx, 0),
+                    jnp.where(okc[..., None] if is_pair else okc, recv_a, 0),
+                    jnp.where(okc, idx, 0),
                     num_segments=M)
                 return (y, overflow, invalid), None
 
             init = jax.lax.pcast(
-                (jnp.zeros(M, dtype), jnp.zeros((), jnp.int64),
+                (jnp.zeros((M,) + ptail, dtype), jnp.zeros((), jnp.int64),
                  jnp.zeros((), jnp.int64)),
                 SHARD_AXIS, to="varying",
             )
             (y, overflow, invalid), _ = jax.lax.scan(
                 chunk, init,
                 (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
-                 xp.reshape(nchunks, B).astype(dtype)),
+                 xp.reshape((nchunks, B) + ptail).astype(dtype)),
             )
             # cross-shard totals so every shard reports the same counters
             overflow = jax.lax.psum(overflow, SHARD_AXIS)
             invalid = jax.lax.psum(invalid, SHARD_AXIS)
             return y[None], overflow[None], invalid[None]
 
-        spec1 = P(SHARD_AXIS, None)
-        specs = P(SHARD_AXIS)
         mesh = self.mesh
-
-        spec2 = P(SHARD_AXIS, None, None)
 
         def apply_fn(x, operands):
             alphas, norms, diag, tables, lk_pair, lk_dir = operands
             f = jax.shard_map(
                 shard_body, mesh=mesh,
-                in_specs=(spec1, spec1, spec1, P(), spec2, spec1),
-                out_specs=(spec1, specs, specs),
+                in_specs=(_pspec(x.ndim), _pspec(2), _pspec(2), P(),
+                          _pspec(3), _pspec(2)),
+                out_specs=(_pspec(x.ndim), _pspec(1), _pspec(1)),
             )
             y, overflow, invalid = f(x.astype(dtype), alphas, norms, tables,
                                      lk_pair, lk_dir)
-            y = y + diag.astype(dtype) * x.astype(dtype)
+            d = diag.astype(dtype)
+            y = y + d.reshape(d.shape + (1,) * (x.ndim - 2)) * x.astype(dtype)
             return y, overflow[0], invalid[0]
 
         self._apply_fn = apply_fn
         self._operands = (self._alphas, self._norms, self._diag, self.tables,
                           self._lk_pair, self._lk_dir)
         _mv = jax.jit(apply_fn)
+        nd_batched = 4 if is_pair else 3
 
         def run(x):
-            if x.ndim == 3:
+            if x.ndim == nd_batched:
                 # batch: apply per column (fused mode favors memory over speed)
-                cols = [_mv(x[..., k], self._operands)
-                        for k in range(x.shape[-1])]
-                y = jnp.stack([c[0] for c in cols], axis=-1)
+                cols = [_mv(x[..., k, :] if is_pair else x[..., k],
+                            self._operands)
+                        for k in range(x.shape[-1 - len(ptail)])]
+                y = jnp.stack([c[0] for c in cols], axis=2)
                 overflow = sum(c[1] for c in cols)
                 invalid = sum(c[2] for c in cols)
                 return y, overflow, invalid
@@ -528,10 +563,16 @@ class DistributedEngine:
     # ------------------------------------------------------------------
 
     def to_hashed(self, x) -> jax.Array:
-        """Block (global sorted) → hashed layout, device-sharded."""
-        xh = self.layout.to_hashed(np.asarray(x), fill=0)
-        sh = self._sh1 if xh.ndim == 2 else self._sh2
-        return jax.device_put(jnp.asarray(xh), sh)
+        """Block (global sorted) → hashed layout, device-sharded.
+
+        For a pair-mode engine, complex input is converted to (re, im)-f64
+        pair form on the host (trailing axis 2) before sharding.
+        """
+        x = np.asarray(x)
+        if self.pair and np.iscomplexobj(x):
+            x = K.pair_from_complex(x)
+        xh = self.layout.to_hashed(x, fill=0)
+        return jax.device_put(jnp.asarray(xh), shard_spec(self.mesh, xh.ndim))
 
     def from_hashed(self, xh) -> np.ndarray:
         return self.layout.from_hashed(np.asarray(xh))
@@ -540,6 +581,8 @@ class DistributedEngine:
         """A normalized random vector directly in hashed layout (pads zero)."""
         rng = np.random.default_rng(seed)
         x = rng.standard_normal(self.n_states)
+        if self.pair:
+            x = np.stack([x, rng.standard_normal(self.n_states)], axis=-1)
         x /= np.linalg.norm(x)
         return self.to_hashed(x)
 
@@ -552,6 +595,11 @@ class DistributedEngine:
         """
         with self.timer.scope("matvec"):
             xh = jnp.asarray(xh)
+            if self.pair and (xh.ndim not in (3, 4) or xh.shape[-1] != 2):
+                raise ValueError(
+                    f"pair-mode engine expects hashed [D, M, 2] or "
+                    f"[D, M, k, 2] (re, im) f64 vectors, got {xh.shape}"
+                )
             y, overflow, invalid = self._matvec(xh)
             if check or (check is None and not self._checked):
                 if int(overflow):
@@ -569,15 +617,32 @@ class DistributedEngine:
         return y
 
     def matvec_global(self, x) -> np.ndarray:
-        """Convenience: block-layout in/out (shuffle → matvec → unshuffle)."""
-        return self.from_hashed(self.matvec(self.to_hashed(x)))
+        """Convenience: block-layout in/out (shuffle → matvec → unshuffle).
 
-    def dot(self, ah, bh) -> jax.Array:
+        Complex input to a pair-mode engine is converted in and back out, so
+        callers see complex128 regardless of the device representation.
+        """
+        was_complex = self.pair and np.iscomplexobj(x)
+        y = self.from_hashed(self.matvec(self.to_hashed(x)))
+        return K.complex_from_pair(y) if was_complex else y
+
+    def dot(self, ah, bh):
         """Global ⟨a, b⟩ over hashed vectors (pad slots are zero by invariant).
         The engine-side analog of PRIMME's ``globalSumReal``
         (PRIMME.chpl:267-311) — XLA turns the sum over the sharded axis into
-        a psum over ICI."""
-        return jnp.vdot(jnp.asarray(ah), jnp.asarray(bh))
+        a psum over ICI.
+
+        For a pair-mode engine the full *complex* inner product is returned
+        (as a Python complex): Re = Σ(a_re·b_re + a_im·b_im),
+        Im = Σ(a_re·b_im − a_im·b_re) — both pure-f64 device reductions.
+        """
+        ah, bh = jnp.asarray(ah), jnp.asarray(bh)
+        if self.pair:
+            re = jnp.vdot(ah, bh)
+            im = jnp.vdot(ah[..., 0], bh[..., 1]) \
+                - jnp.vdot(ah[..., 1], bh[..., 0])
+            return complex(float(re), float(im))
+        return jnp.vdot(ah, bh)
 
     def __call__(self, xh):
         return self.matvec(xh)

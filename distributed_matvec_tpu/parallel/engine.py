@@ -91,16 +91,36 @@ def choose_ell_split(hist: np.ndarray, n_rows: int, T: int,
     return T0, S, Tmax
 
 
+def use_pair_complex(platform: str | None = None) -> bool:
+    """Whether complex sectors should run in (re, im)-f64 pair form.
+
+    ``complex_pair="auto"`` picks pair form exactly on the TPU backend,
+    whose compiler cannot handle complex128 (see
+    :func:`check_complex_backend`); native c128 is kept elsewhere (CPU
+    compiles it fine and the dense cross-checks run against it).
+    """
+    knob = get_config().complex_pair
+    if knob == "on":
+        return True
+    if knob == "off":
+        return False
+    if knob != "auto":
+        raise ValueError(
+            f"unknown complex_pair setting {knob!r} (use auto | on | off)")
+    return (platform or jax.default_backend()) == "tpu"
+
+
 def check_complex_backend(effective_is_real: bool,
                           platform: str | None = None) -> None:
-    """Refuse complex128 engines on a TPU backend unless overridden.
+    """Refuse *native-c128* engines on a TPU backend unless overridden.
 
     Measured on this platform: any complex128 program hangs the TPU
     compiler indefinitely (f64 and c64 compile in <1 s; even
-    ``(a·conj(a)).real.sum()`` on 128 elements never returns).  Momentum
-    sectors with complex characters therefore cannot run on the chip yet —
-    fail loudly with the workarounds instead of hanging for hours.  The
-    ``allow_complex_on_tpu`` knob bypasses the guard for TPU stacks whose
+    ``(a·conj(a)).real.sum()`` on 128 elements never returns).  Complex
+    momentum sectors normally never hit this guard — with
+    ``complex_pair="auto"`` they run in (re, im)-f64 pair form on TPU —
+    it only fires when pair form is forced off.  The
+    ``allow_complex_on_tpu`` knob bypasses it for TPU stacks whose
     compiler handles c128.
     """
     if effective_is_real:
@@ -110,11 +130,12 @@ def check_complex_backend(effective_is_real: bool,
     if get_config().allow_complex_on_tpu:
         return
     raise RuntimeError(
-        "complex128 engines are disabled on the TPU backend: this "
+        "native complex128 engines are disabled on the TPU backend: this "
         "platform's compiler hangs on any complex128 program. Options: "
-        "run the momentum sector on CPU (JAX_PLATFORMS=cpu), pick a real "
-        "sector (0 or half-period — see Operator.effective_is_real), or "
-        "set allow_complex_on_tpu=True if your TPU stack compiles c128."
+        "leave complex_pair='auto' (runs the sector in (re,im)-f64 pair "
+        "form), run on CPU (JAX_PLATFORMS=cpu), pick a real sector (0 or "
+        "half-period — see Operator.effective_is_real), or set "
+        "allow_complex_on_tpu=True if your TPU stack compiles c128."
     )
 
 
@@ -155,8 +176,13 @@ class LocalEngine:
         self.operator = operator
         self.mode = mode
         self.real = operator.effective_is_real
-        check_complex_backend(self.real)
-        self._dtype = jnp.float64 if self.real else jnp.complex128
+        # Complex sectors: (re, im)-f64 pair form on TPU (vectors carry a
+        # trailing axis of 2), native c128 elsewhere.
+        self.pair = (not self.real) and use_pair_complex()
+        if not self.pair:
+            check_complex_backend(self.real)
+        self._dtype = jnp.float64 if (self.real or self.pair) \
+            else jnp.complex128
         n = basis.number_states
         b = min(batch_size or cfg.matvec_batch_size, max(n, 1))
         n_pad = pad_to_multiple(n, b)
@@ -176,7 +202,7 @@ class LocalEngine:
         self._lk_dir = jnp.asarray(dir_tab)       # [2^b + 1] i32
         self._alphas = jnp.asarray(alphas)        # [N_pad]
         self._norms = jnp.asarray(nrm)            # [N_pad]
-        self.tables = K.device_tables(operator)
+        self.tables = K.device_tables(operator, pair=self.pair)
         self.num_terms = int(self.tables.off.x.shape[0])
 
         # NOTE on jit hygiene: every large device array (tables, diag, the
@@ -220,6 +246,7 @@ class LocalEngine:
         norms_c = self._norms.reshape(C, b)
         T = self.num_terms
         lk_shift, lk_probes = self._lk_shift, self._lk_probes
+        is_pair = self.pair
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def fill_chunk(idx_buf, coeff_buf, bad, tables, pair, dir_tab,
@@ -231,19 +258,22 @@ class LocalEngine:
             idx, cf, invalid = K.mask_structure(
                 cf, idx.reshape(betas.shape), found.reshape(betas.shape),
                 alphas != SENTINEL_STATE)
-            # Transposed [T, N_pad] layout: the matvec walks terms outermost,
-            # so per-term rows are contiguous (measured ~2× over [N_pad, T]
-            # + axis-1 reduce on v5e).
+            # Transposed [T, N_pad(, 2)] layout: the matvec walks terms
+            # outermost, so per-term rows are contiguous (measured ~2× over
+            # [N_pad, T] + axis-1 reduce on v5e).
             zero = jnp.zeros((), start.dtype)
+            starts2 = (zero, start)
             idx_buf = jax.lax.dynamic_update_slice(
-                idx_buf, idx.T.astype(jnp.int32), (zero, start))
+                idx_buf, idx.T.astype(jnp.int32), starts2)
             coeff_buf = jax.lax.dynamic_update_slice(
-                coeff_buf, cf.T, (zero, start))
+                coeff_buf, jnp.moveaxis(cf, 0, 1),
+                starts2 + ((zero,) if is_pair else ()))
             return idx_buf, coeff_buf, bad + invalid
 
         idx_buf = jnp.zeros((T, self.n_padded), jnp.int32)
-        coeff_buf = jnp.zeros((T, self.n_padded),
-                              jnp.float64 if self.real else jnp.complex128)
+        cshape = (T, self.n_padded, 2) if is_pair else (T, self.n_padded)
+        coeff_buf = jnp.zeros(cshape, jnp.float64 if (self.real or is_pair)
+                              else jnp.complex128)
         bad = jnp.zeros((), jnp.int64)
         for ci in range(C):
             log_debug(f"ell build chunk {ci}/{C}")
@@ -273,16 +303,21 @@ class LocalEngine:
         T = self.num_terms
         n_pad = self.n_padded
         b, C = self.batch_size, self.num_chunks
+        is_pair = self.pair
         if n_pad == 0:
             self._ell_T0 = T
             self._ell_idx, self._ell_coeff = idx_buf, coeff_buf
             self._ell_tail = None
             return
 
+        def dead(cf):
+            """Per-entry 'no matrix element' mask ([T, ...] bool)."""
+            return (cf == 0).all(axis=-1) if is_pair else (cf == 0)
+
         # Phase 1 — row-nnz histogram only; no table-sized allocation.
         @jax.jit
         def count(cf_b):
-            nnz = (cf_b != 0).sum(axis=0)
+            nnz = (~dead(cf_b)).sum(axis=0)
             hist = jnp.zeros(T + 1, jnp.int64).at[nnz].add(1)
             return nnz, hist
 
@@ -307,19 +342,24 @@ class LocalEngine:
         @partial(jax.jit, donate_argnums=(0, 1))
         def pack_chunk(out_idx, out_cf, idx_b, cf_b, start):
             zero = jnp.zeros((), start.dtype)
+            pstart = ((zero,) if is_pair else ())
+            psize = ((2,) if is_pair else ())
             idx_c = jax.lax.dynamic_slice(idx_b, (zero, start), (T, b))
-            cf_c = jax.lax.dynamic_slice(cf_b, (zero, start), (T, b))
-            order = jnp.argsort(cf_c == 0, axis=0, stable=True)[:T0]
+            cf_c = jax.lax.dynamic_slice(
+                cf_b, (zero, start) + pstart, (T, b) + psize)
+            order = jnp.argsort(dead(cf_c), axis=0, stable=True)[:T0]
             out_idx = jax.lax.dynamic_update_slice(
                 out_idx, jnp.take_along_axis(idx_c, order, axis=0),
                 (zero, start))
+            cf_o = jnp.take_along_axis(
+                cf_c, order[..., None] if is_pair else order, axis=0)
             out_cf = jax.lax.dynamic_update_slice(
-                out_cf, jnp.take_along_axis(cf_c, order, axis=0),
-                (zero, start))
+                out_cf, cf_o, (zero, start) + pstart)
             return out_idx, out_cf
 
         out_idx = jnp.zeros((T0, n_pad), jnp.int32)
-        out_cf = jnp.zeros((T0, n_pad), coeff_buf.dtype)
+        out_cf = jnp.zeros((T0, n_pad) + ((2,) if is_pair else ()),
+                           coeff_buf.dtype)
         for ci in range(C):
             out_idx, out_cf = pack_chunk(out_idx, out_cf, idx_buf,
                                          coeff_buf, jnp.int32(ci * b))
@@ -337,9 +377,10 @@ class LocalEngine:
             rows = jnp.nonzero(nnz > T0, size=S, fill_value=0)[0]
             rows = rows.astype(jnp.int32)
             idx_r, cf_r = idx_b[:, rows], cf_b[:, rows]
-            order = jnp.argsort(cf_r == 0, axis=0, stable=True)[T0:Tmax]
+            order = jnp.argsort(dead(cf_r), axis=0, stable=True)[T0:Tmax]
             return (rows, jnp.take_along_axis(idx_r, order, axis=0),
-                    jnp.take_along_axis(cf_r, order, axis=0))
+                    jnp.take_along_axis(
+                        cf_r, order[..., None] if is_pair else order, axis=0))
 
         self._ell_tail = build_tail(idx_buf, coeff_buf, nnz)
 
@@ -349,36 +390,42 @@ class LocalEngine:
         dtype = self._dtype
         has_tail = self._ell_tail is not None
         use_sg = split_gather_enabled()
+        is_pair = self.pair
+        nd_base = 2 if is_pair else 1    # ndim of one unbatched vector
 
         def apply_fn(x, operands):
             idx, coeff, diag, tail = operands
             x = jnp.asarray(x).astype(dtype)
-            batched = x.ndim == 2
+            batched = x.ndim == nd_base + 1
             gx = prep_gather(x, dtype, use_sg)
+
+            def contrib(c, g):
+                # c: per-row coefficient [rows(, 2)]; g: gathered x rows
+                if is_pair:
+                    return K.cmul_pair(c[:, None, :] if batched else c, g)
+                return (c[:, None] if batched else c) * g
 
             def terms(y, idx, coeff, width, sl=None):
                 if width <= 64:
                     # Unrolled per-term gathers — contiguous coeff rows.
                     for t in range(width):
-                        c = coeff[t]
-                        acc = (c[:, None] if batched else c) * gx(idx[t])
+                        acc = contrib(coeff[t], gx(idx[t]))
                         y = y + (acc[:n] if sl else acc)
                 else:
                     def step(y, args):
                         i, c = args
-                        contrib = (c[:, None] if batched else c) * gx(i)
-                        return y + (contrib[:n] if sl else contrib), None
+                        acc = contrib(c, gx(i))
+                        return y + (acc[:n] if sl else acc), None
                     y, _ = jax.lax.scan(step, y,
                                         (idx[:width], coeff[:width]))
                 return y
 
             d = diag[:n].astype(dtype)
-            y = (d[:, None] if batched else d) * x
+            y = d.reshape((n,) + (1,) * (x.ndim - 1)) * x
             y = terms(y, idx, coeff, T0, sl=True)
             if has_tail:
                 rows, idx_t, cf_t = tail
-                zshape = (rows.shape[0], x.shape[1]) if batched \
-                    else rows.shape
+                zshape = rows.shape + x.shape[1:]
                 acc = terms(jnp.zeros(zshape, dtype), idx_t, cf_t,
                             idx_t.shape[0])
                 y = y.at[rows].add(acc, mode="drop")
@@ -397,10 +444,13 @@ class LocalEngine:
         dtype = self._dtype
         use_sg = split_gather_enabled()
         lk_shift, lk_probes = self._lk_shift, self._lk_probes
+        is_pair = self.pair
+        nd_base = 2 if is_pair else 1
 
         def apply_fn(x, operands):
             tables, pair, dir_tab, alphas_c, norms_c, diag = operands
             x = jnp.asarray(x).astype(dtype)
+            batched = x.ndim == nd_base + 1
             gx = prep_gather(x, dtype, use_sg)
 
             def chunk(args):
@@ -412,17 +462,18 @@ class LocalEngine:
                 idx, coeff, invalid = K.mask_structure(
                     coeff, idx.reshape(betas.shape),
                     found.reshape(betas.shape), alphas != SENTINEL_STATE)
-                g = gx(idx)
-                if x.ndim == 2:
-                    yc = jnp.sum(coeff[..., None] * g, axis=1)
+                g = gx(idx)                      # [B, T] + x.shape[1:]
+                if is_pair:
+                    cb = coeff[:, :, None, :] if batched else coeff
+                    prod = K.cmul_pair(cb, g)
                 else:
-                    yc = jnp.sum(coeff * g, axis=1)
-                return yc, invalid
+                    prod = (coeff[..., None] if batched else coeff) * g
+                return jnp.sum(prod, axis=1), invalid
 
             y_chunks, invalid = jax.lax.map(chunk, (alphas_c, norms_c))
             y = y_chunks.reshape((C * b,) + x.shape[1:])[:n]
             d = diag[:n].astype(dtype)
-            y = y + (d[:, None] if x.ndim == 2 else d) * x
+            y = y + d.reshape((n,) + (1,) * (x.ndim - 1)) * x
             return y, jnp.sum(invalid)
 
         self._apply_fn = apply_fn
@@ -437,12 +488,27 @@ class LocalEngine:
     def matvec(self, x, check: Optional[bool] = None) -> jax.Array:
         """y = H·x (or H·X for [N, k] batches).
 
+        A pair-mode engine (``self.pair``) consumes/produces f64 arrays with
+        a trailing (re, im) axis: [N, 2] or [N, k, 2].  Complex input is
+        converted on the host and complex output is returned for it, so
+        callers may stay in complex form at a host round-trip cost;
+        performance-sensitive loops (solvers) should pass pair arrays.
+
         In fused mode the first call (or ``check=True``) verifies that no
         nonzero matrix element targets a state outside the basis — the
         engine-level halt of the reference (DistributedMatrixVector.chpl:113-118).
         In ell mode that check already ran at structure-build time.
         """
         with self.timer.scope("matvec"):
+            was_complex = self.pair and np.iscomplexobj(x)
+            if was_complex:
+                x = K.pair_from_complex(np.asarray(x))
+            if self.pair and (np.ndim(x) not in (2, 3)
+                              or np.shape(x)[-1] != 2):
+                raise ValueError(
+                    f"pair-mode engine expects [N, 2] or [N, k, 2] (re, im) "
+                    f"f64 vectors (or complex input), got shape {np.shape(x)}"
+                )
             y, bad = self._matvec(jnp.asarray(x))
             if check or (check is None and not self._checked):
                 if int(bad) != 0:
@@ -451,7 +517,7 @@ class LocalEngine:
                         "— operator does not preserve the chosen sector"
                     )
                 self._checked = True
-        return y
+        return K.complex_from_pair(np.asarray(y)) if was_complex else y
 
     def __call__(self, x):
         return self.matvec(x)

@@ -107,12 +107,21 @@ def _buffer_rows(mcap: int) -> int:
     return mcap + 1 + (-(mcap + 1)) % _GS_BLOCK
 
 
-def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
+def _make_block_runner(mv, mcap, shape, dtype, n_reorth, pair=False):
     """One jitted program advancing the recurrence by ``nsteps`` iterations.
 
     State: V [_buffer_rows, *shape] basis buffer (donated), alph/bet [mcap]
     f64.  Each iteration: w = H·V[m]; α = ⟨v, w⟩; ``n_reorth`` passes of
     blocked MGS against the live rows; β = ‖w‖; V[m+1] = w/β.
+
+    ``pair=True`` marks (re, im)-f64 pair vectors (trailing axis 2, the
+    TPU-safe complex form).  The realified operator commutes with
+    J: (re, im) ↦ (−im, re) (multiplication by i), so each eigenvalue of the
+    complex H appears twice — once along v, once along J·v.  MGS therefore
+    orthogonalizes against J·V as well: ⟨v, w⟩ and ⟨J·v, w⟩ are exactly
+    Re and −Im of the complex ⟨z, w⟩, so the J-aware recurrence *is*
+    complex-arithmetic Lanczos (each eigenvalue once, no phantom copies) —
+    in pure f64.
 
     ``mv(x, operands)`` is a pure function: the engine's matrix tables ride
     in ``operands`` as real jit arguments.  Closing over them instead would
@@ -121,6 +130,12 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
     """
     nflat = int(np.prod(shape))
     nrows = _buffer_rows(mcap)
+
+    def J_rows(A):
+        """Multiply-by-i on flattened pair rows: (re, im) → (−im, re)."""
+        p = A.reshape(A.shape[:-1] + (nflat // 2, 2))
+        return jnp.stack([-p[..., 1], p[..., 0]],
+                         axis=-1).reshape(A.shape)
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def run_block(V, alph, bet, m0, nsteps, operands):
@@ -133,7 +148,12 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth):
                     Vf, (r0, jnp.zeros((), r0.dtype)), (_GS_BLOCK, nflat))
                 mask = (r0 + jnp.arange(_GS_BLOCK)) <= m
                 c = (Vb.conj() @ wf) * mask.astype(wf.dtype)
-                return wf - c @ Vb
+                wf = wf - c @ Vb
+                if pair:
+                    VbJ = J_rows(Vb)
+                    cj = (VbJ @ wf) * mask.astype(wf.dtype)
+                    wf = wf - cj @ VbJ
+                return wf
 
             return jax.lax.fori_loop(0, nblk, blk, wf)
 
@@ -190,6 +210,7 @@ def lanczos(
     max_basis_size: Optional[int] = None,
     min_restart_size: Optional[int] = None,
     check_every: int = 16,
+    pair: Optional[bool] = None,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs of the Hermitian operator behind ``matvec``.
 
@@ -199,13 +220,35 @@ def lanczos(
     reference driver's ``kMaxBasisSize``/``kMinRestartSize``
     (Diagonalize.chpl:169-170) and bound device memory at
     ``(max_basis_size+1)`` vectors via thick restarts.
+
+    ``pair`` marks (re, im)-f64 pair vectors (see ``_make_block_runner``);
+    default: auto-detected from a pair-mode engine behind ``matvec``.
     """
+    # Engines expose (apply_fn, operands) so the block runner can pass the
+    # matrix tables as jit arguments; plain callables fall back to empty
+    # operands (fine unless they close over very large device arrays).
+    # Only the engine's own ``matvec`` method is substituted — any other
+    # bound method (shifted/wrapped/global-layout variants) must keep its
+    # semantics and goes through the generic fallback.
+    owner = getattr(matvec, "__self__", None)
+    if pair is None:
+        pair = bool(getattr(owner, "pair", False))
+
     if v0 is None:
         if n is None:
             raise ValueError("pass v0 or n")
-        v0 = _rand_like((n,), np.float64, seed)
+        v0 = _rand_like((n, 2) if pair else (n,), np.float64, seed)
+    elif pair and np.iscomplexobj(v0):
+        # warm starts may arrive in complex form; the recurrence (and the
+        # engine's bound apply_fn) runs on (re, im)-f64 pair vectors
+        from ..ops.kernels import pair_from_complex
+        v0 = pair_from_complex(np.asarray(v0))
     v = jnp.asarray(v0)
     shape = v.shape
+    if pair and (len(shape) < 2 or shape[-1] != 2):
+        raise ValueError(
+            f"pair-mode Lanczos needs an [..., 2] (re, im) f64 start vector "
+            f"(or complex v0), got shape {shape}")
 
     # Probe matvec once eagerly: fixes the recurrence dtype (a complex
     # Hermitian operator promotes a real start vector) and lets engines run
@@ -216,13 +259,6 @@ def lanczos(
     dtype = jnp.promote_types(v.dtype, w_probe.dtype)
     del w_probe
 
-    # Engines expose (apply_fn, operands) so the block runner can pass the
-    # matrix tables as jit arguments; plain callables fall back to empty
-    # operands (fine unless they close over very large device arrays).
-    # Only the engine's own ``matvec`` method is substituted — any other
-    # bound method (shifted/wrapped/global-layout variants) must keep its
-    # semantics and goes through the generic fallback.
-    owner = getattr(matvec, "__self__", None)
     if (owner is not None and hasattr(owner, "bound_matvec")
             and getattr(matvec, "__func__", None)
             is getattr(type(owner), "matvec", None)):
@@ -246,7 +282,8 @@ def lanczos(
     alph_d = jnp.zeros(mcap, jnp.float64)
     bet_d = jnp.zeros(mcap, jnp.float64)
 
-    run_block = _make_block_runner(mv, mcap, shape, dtype, n_reorth)
+    run_block = _make_block_runner(mv, mcap, shape, dtype, n_reorth,
+                                   pair=pair)
     restart_fn = _make_restart(mcap, shape, dtype, l_restart)
 
     lock_theta = np.zeros(0)

@@ -39,10 +39,16 @@ class RuntimeConfig:
     matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
+    complex_pair: str = "auto"             # (re,im)-f64 pair engines for
+    #   complex sectors: auto | on | off.  auto = pair form on the TPU
+    #   backend (whose compiler cannot handle complex128 — see below),
+    #   native c128 elsewhere.  "on" forces pair everywhere (useful for
+    #   testing), "off" forces native c128 (subject to the TPU guard).
     allow_complex_on_tpu: bool = False     # override the c128-on-TPU guard
     #   (measured here: ANY complex128 program hangs this platform's TPU
     #    compiler indefinitely while f64 and c64 compile in <1 s; engines
-    #    refuse complex sectors on the TPU backend unless this is set)
+    #    refuse native-c128 sectors on the TPU backend unless this is set —
+    #    with complex_pair="auto" they run in pair form instead)
 
 
 

@@ -1,0 +1,184 @@
+"""(re, im)-f64 pair engines for complex-character momentum sectors.
+
+The TPU compiler on this platform cannot handle complex128 (see
+``check_complex_backend``); complex sectors run in *pair* form instead:
+vectors carry a trailing (re, im) axis, the Hermitian H on C^N acts as the
+real-symmetric [[Hr, −Hi], [Hi, Hr]] on R^{2N}, and Lanczos orthogonalizes
+against J·V (J = multiply by i) — which is exactly complex Lanczos in f64
+arithmetic.  These tests force ``complex_pair="on"`` on CPU and compare
+against the independent dense Kronecker+projector reference and native-c128
+results at the reference's tolerances (TestMatrixVectorProduct.chpl:15-16).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu.ops.kernels import (complex_from_pair,
+                                                pair_from_complex)
+from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+from distributed_matvec_tpu.parallel.engine import LocalEngine
+from distributed_matvec_tpu.solve import lanczos
+from distributed_matvec_tpu.utils.config import update_config
+
+from test_operator import build_heisenberg, dense_effective_matrix
+
+ATOL, RTOL = 1e-13, 1e-12
+
+# Momentum sectors with genuinely complex characters; n=12 sector 2 has
+# orbits whose character sum cancels exactly (norm must snap to 0).
+SECTORS = [
+    (10, 5, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 0], 1)]),
+    (12, 6, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 2)]),
+    (12, 6, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 3)]),
+]
+
+
+@pytest.fixture
+def pair_mode():
+    update_config(complex_pair="on")
+    yield
+    update_config(complex_pair="auto")
+
+
+def _complex_sector_op(n, hw, syms):
+    op = build_heisenberg(n, hw, None, syms)
+    op.basis.build()
+    assert not op.effective_is_real
+    return op
+
+
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+@pytest.mark.parametrize("n,hw,syms", SECTORS)
+def test_local_pair_matches_dense(n, hw, syms, mode, pair_mode, rng):
+    op = _complex_sector_op(n, hw, syms)
+    h = dense_effective_matrix(op)
+    N = op.basis.number_states
+    x = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    X = (rng.random((N, 3)) - 0.5) + 1j * (rng.random((N, 3)) - 0.5)
+    eng = LocalEngine(op, batch_size=61, mode=mode)
+    assert eng.pair
+    # complex in → complex out (host conversion round-trip)
+    y = np.asarray(eng.matvec(x))
+    np.testing.assert_allclose(y, h @ x, atol=ATOL, rtol=RTOL)
+    # pair in → pair out (the solver-facing form)
+    yp = np.asarray(eng.matvec(pair_from_complex(x)))
+    np.testing.assert_allclose(complex_from_pair(yp), h @ x,
+                               atol=ATOL, rtol=RTOL)
+    # rank-2 batch
+    Y = np.asarray(eng.matvec(X))
+    np.testing.assert_allclose(Y, h @ X, atol=ATOL, rtol=RTOL)
+
+
+@pytest.mark.parametrize("mode", ["ell", "fused"])
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_distributed_pair_matches_dense(n_devices, mode, pair_mode, rng):
+    op = _complex_sector_op(12, 6, SECTORS[1][2])
+    h = dense_effective_matrix(op)
+    N = op.basis.number_states
+    x = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    X = (rng.random((N, 3)) - 0.5) + 1j * (rng.random((N, 3)) - 0.5)
+    eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+    assert eng.pair
+    np.testing.assert_allclose(eng.matvec_global(x), h @ x,
+                               atol=ATOL, rtol=RTOL)
+    Yh = eng.matvec(eng.to_hashed(X))
+    np.testing.assert_allclose(complex_from_pair(eng.from_hashed(Yh)),
+                               h @ X, atol=ATOL, rtol=RTOL)
+
+
+def test_pair_matches_native_c128(pair_mode, rng):
+    """Pair and native-c128 engines agree to machine precision."""
+    op = _complex_sector_op(12, 6, SECTORS[1][2])
+    N = op.basis.number_states
+    x = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    y_pair = np.asarray(LocalEngine(op, mode="ell").matvec(x))
+    update_config(complex_pair="off")
+    y_native = np.asarray(LocalEngine(op, mode="ell").matvec(x))
+    np.testing.assert_allclose(y_pair, y_native, atol=1e-15, rtol=1e-14)
+
+
+def test_pair_lanczos_no_phantom_degeneracy(pair_mode):
+    """J-aware Lanczos returns each eigenvalue ONCE (complex Lanczos in f64),
+    not the doubled spectrum of the naive realification."""
+    op = _complex_sector_op(12, 6, SECTORS[1][2])
+    h = dense_effective_matrix(op)
+    w = np.linalg.eigvalsh(h)
+    eng = LocalEngine(op, mode="ell")
+    res = lanczos(eng.matvec, n=op.basis.number_states, k=3, tol=1e-10,
+                  compute_eigenvectors=True)
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues, w[:3], atol=1e-9)
+    # eigenvector solves the COMPLEX eigenproblem
+    v = np.asarray(res.eigenvectors[0])
+    vc = complex_from_pair(v)
+    assert np.linalg.norm(h @ vc - res.eigenvalues[0] * vc) < 1e-8
+
+
+def test_pair_lanczos_distributed(pair_mode):
+    op = _complex_sector_op(12, 6, SECTORS[1][2])
+    w = np.linalg.eigvalsh(dense_effective_matrix(op))
+    eng = DistributedEngine(op, n_devices=4, mode="ell")
+    res = lanczos(eng.matvec, v0=eng.random_hashed(seed=7), k=2, tol=1e-10)
+    assert res.converged
+    np.testing.assert_allclose(res.eigenvalues, w[:2], atol=1e-9)
+
+
+def test_pair_dot_is_complex(pair_mode, rng):
+    """DistributedEngine.dot returns the full complex overlap in pair mode."""
+    op = _complex_sector_op(10, 5, SECTORS[0][2])
+    eng = DistributedEngine(op, n_devices=2, mode="ell")
+    N = op.basis.number_states
+    a = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    b = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    got = eng.dot(eng.to_hashed(a), eng.to_hashed(b))
+    np.testing.assert_allclose(got, np.vdot(a, b), atol=1e-13)
+
+
+def test_pair_rejects_bad_shapes(pair_mode):
+    op = _complex_sector_op(10, 5, SECTORS[0][2])
+    eng = LocalEngine(op, mode="ell")
+    with pytest.raises(ValueError, match="pair-mode"):
+        eng.matvec(np.ones(op.basis.number_states))   # real [N]: ambiguous
+    deng = DistributedEngine(op, n_devices=2, mode="ell")
+    with pytest.raises(ValueError, match="pair-mode"):
+        deng.matvec(np.ones((2, deng.shard_size)))
+
+
+def test_diagonalize_cli_pair(tmp_path, pair_mode):
+    """The driver CLI solves a complex momentum sector end-to-end in pair
+    mode and saves complex eigenvectors."""
+    import h5py
+    import yaml
+
+    cfg = {
+        "basis": {"number_spins": 10, "hamming_weight": 5,
+                  "symmetries": [
+                      {"permutation": [1, 2, 3, 4, 5, 6, 7, 8, 9, 0],
+                       "sector": 1}]},
+        "hamiltonian": {"name": "H", "terms": [
+            {"expression": "σˣ₀ σˣ₁", "sites": [[i, (i + 1) % 10]
+                                                for i in range(10)]},
+            {"expression": "σʸ₀ σʸ₁", "sites": [[i, (i + 1) % 10]
+                                                for i in range(10)]},
+            {"expression": "σᶻ₀ σᶻ₁", "sites": [[i, (i + 1) % 10]
+                                                for i in range(10)]},
+        ]},
+    }
+    yml = tmp_path / "momentum.yaml"
+    yml.write_text(yaml.dump(cfg))
+    out = tmp_path / "momentum.h5"
+
+    import sys
+    sys.path.insert(0, "apps")
+    import diagonalize
+    rc = diagonalize.main([str(yml), "-o", str(out), "-k", "2",
+                           "--tol", "1e-10"])
+    assert rc == 0
+
+    op = _complex_sector_op(10, 5, SECTORS[0][2])
+    w = np.linalg.eigvalsh(dense_effective_matrix(op))
+    with h5py.File(out, "r") as f:
+        evals = f["hamiltonian/eigenvalues"][...]
+        evecs = f["hamiltonian/eigenvectors"][...]
+    np.testing.assert_allclose(evals, w[:2], atol=1e-9)
+    assert np.iscomplexobj(evecs)
