@@ -118,16 +118,16 @@ def main(argv=None):
 
     with timer.scope("solve"), maybe_profile():
         t0 = time.perf_counter()
-        if args.block and getattr(eng, "pair", False):
-            print("--block (LOBPCG) does not support pair-form complex "
-                  "sectors; use Lanczos (default) or run on CPU "
-                  "(JAX_PLATFORMS=cpu)", file=sys.stderr)
-            return 2
         if args.block:
+            if getattr(eng, "pair", False) and hasattr(eng, "from_hashed"):
+                print("--block (LOBPCG) does not support distributed "
+                      "pair-form complex sectors; use Lanczos (default)",
+                      file=sys.stderr)
+                return 2
             evals, evecs_cols, iters = lobpcg(
                 eng.matvec, n, k=args.num_evals, tol=args.tol,
                 max_iters=args.max_iters)
-            evecs = [evecs_cols[:, i] for i in range(args.num_evals)]
+            evecs = [evecs_cols[:, i] for i in range(evecs_cols.shape[1])]
             residuals = np.array([
                 float(np.linalg.norm(np.asarray(eng.matvec(v))
                                      - w * np.asarray(v)))
@@ -158,7 +158,9 @@ def main(argv=None):
             v = np.asarray(v)
             if hasattr(eng, "from_hashed") and v.ndim == hashed_ndim:
                 v = eng.from_hashed(v)   # hashed → block order for I/O
-            if is_pair:                  # (re, im) pair → complex for I/O
+            if is_pair and not np.iscomplexobj(v):
+                # (re, im) pair → complex for I/O (LOBPCG already
+                # returns complex columns)
                 from distributed_matvec_tpu.ops.kernels import (
                     complex_from_pair)
                 v = complex_from_pair(v)

@@ -88,7 +88,17 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         idx = op.basis.state_index(rep_b)
         host_ms = ((time.perf_counter() - t0) * (n / host_sample_rows)) * 1e3
         host_estimated = True
-        err = float("nan")
+        # correctness on the sampled rows in row (gather) form:
+        # y[i] = d(α_i)·x[i] + Σ_t conj(amps·χ*)·(n_β/n_α)·x[index(rep β)]
+        norms = op.basis.norms
+        coeff = np.conj(amps.reshape(-1) * chars) \
+            * (norm_b / np.repeat(norms[sl], betas.shape[1]))
+        # out-of-basis betas carry coeff == 0 (norm_b = 0), so the clipped
+        # index can only pick up a zero contribution
+        vals = coeff * x[np.clip(idx, 0, n - 1)]
+        y_rows = op.apply_diag(reps[sl]) * x[sl] \
+            + vals.reshape(betas.shape).sum(axis=1)
+        err = float(np.max(np.abs(y[sl] - y_rows)))
     else:
         t0 = time.perf_counter()
         for _ in range(host_repeats):

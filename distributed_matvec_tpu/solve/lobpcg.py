@@ -36,28 +36,123 @@ def _norm_estimate(matvec: Callable, n: int, iters: int = 20, seed: int = 3):
 
 def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
            tol: float = 1e-9, seed: int = 0,
-           X0: Optional[np.ndarray] = None) -> Tuple[np.ndarray, np.ndarray, int]:
+           X0: Optional[np.ndarray] = None,
+           pair: Optional[bool] = None
+           ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Lowest-``k`` eigenpairs via spectrum-flipped LOBPCG.
 
     Returns (eigenvalues [k] ascending, eigenvectors [n, k], iterations).
     Requires a matvec that accepts rank-2 ``[n, k]`` blocks (both engines do).
+
+    ``pair`` (auto-detected from a pair-mode engine) runs the realified
+    operator on R^{2n}: each complex eigenvalue appears twice (along v and
+    J·v), so the block is doubled to 2k and complex-parallel duplicates are
+    filtered from the result; eigenvectors come back complex ``[n, k]``.
     """
     from jax.experimental.sparse.linalg import lobpcg_standard
+
+    owner = getattr(matvec, "__self__", None)
+    if pair is None:
+        pair = bool(getattr(owner, "pair", False))
 
     def mv1(x):
         y = matvec(x)
         return y[0] if isinstance(y, tuple) else y
 
-    sigma = _norm_estimate(mv1, n)
+    if not pair:
+        sigma = _norm_estimate(mv1, n)
 
-    def flipped(X):
-        return sigma * X - mv1(X)
+        def flipped(X):
+            return sigma * X - mv1(X)
 
-    if X0 is None:
-        X0 = np.random.default_rng(seed).standard_normal((n, k))
-    X0, _ = np.linalg.qr(X0)
+        if X0 is None:
+            X0 = np.random.default_rng(seed).standard_normal((n, k))
+        X0, _ = np.linalg.qr(X0)
+        theta, U, iters = lobpcg_standard(
+            flipped, jnp.asarray(X0), m=max_iters, tol=tol)
+        evals = sigma - np.asarray(theta)
+        order = np.argsort(evals)
+        return evals[order], np.asarray(U)[:, order], int(iters)
+
+    # -- pair form: flat realified operator on R^{2n} -----------------------
+    if hasattr(owner, "from_hashed"):
+        raise ValueError(
+            "pair-mode LOBPCG supports local engines only (the realified "
+            "block is in flat block order, not the hashed [D, M, 2] layout "
+            "a DistributedEngine consumes); use solve.lanczos for "
+            "distributed complex sectors"
+        )
+    # 2k for the J-doubling plus 2 guard vectors: the tail of an LOBPCG
+    # block converges last, and the k-th *distinct* eigenvalue sits at
+    # block position 2k-1 without the guard.  jax's lobpcg_standard
+    # requires 5·block < dim, i.e. 5·(2k+2) < 2n here.
+    kk = 2 * k + 2
+    if 5 * kk >= 2 * n:
+        raise ValueError(
+            f"pair-mode LOBPCG needs n > 5·(k+1) (jax lobpcg block bound on "
+            f"the realified R^{{2n}}); got n={n}, k={k} — reduce k or use "
+            "solve.lanczos"
+        )
+
+    def mv_flat(U):
+        """[2n, m] f64 → engine pair batch [n, m, 2] → back."""
+        if U.ndim == 1:           # norm-estimate probe vector
+            return mv_flat(U[:, None])[:, 0]
+        m = U.shape[1]
+        X = jnp.transpose(U.reshape(n, 2, m), (0, 2, 1))
+        Y = mv1(X)
+        return jnp.transpose(Y, (0, 2, 1)).reshape(2 * n, m)
+
+    sigma = _norm_estimate(mv_flat, 2 * n)
+
+    def flipped(U):
+        return sigma * U - mv_flat(U)
+
+    rng = np.random.default_rng(seed)
+    U0 = rng.standard_normal((2 * n, kk))
+    if X0 is not None:
+        # warm start: complex [n, j] columns (j ≤ k) realified into the
+        # leading block columns; remaining columns stay random
+        X0 = np.asarray(X0)
+        if X0.ndim != 2 or X0.shape[0] != n or X0.shape[1] > k:
+            raise ValueError(
+                f"pair-mode X0 must be complex [n, j] with j <= k="
+                f"{k}, got shape {X0.shape}"
+            )
+        # realify in the (re, im)-interleaved row layout mv_flat uses
+        U0[:, : X0.shape[1]] = np.stack(
+            [X0.real, X0.imag], axis=1).reshape(2 * n, X0.shape[1])
+    U0, _ = np.linalg.qr(U0)
     theta, U, iters = lobpcg_standard(
-        flipped, jnp.asarray(X0), m=max_iters, tol=tol)
+        flipped, jnp.asarray(U0), m=max_iters, tol=tol)
     evals = sigma - np.asarray(theta)
     order = np.argsort(evals)
-    return evals[order], np.asarray(U)[:, order], int(iters)
+    evals, U = evals[order], np.asarray(U)[:, order]
+    # Complex view; keep one representative per complex direction.  A J-copy
+    # of a kept vector lies entirely in the complex span of the kept set at
+    # that eigenvalue, so complex Gram-Schmidt against the kept vectors
+    # leaves ~zero residual for copies while a genuinely degenerate partner
+    # retains an O(1) independent component (which we keep, orthonormalized —
+    # so returned vectors are complex-orthonormal even within degenerate
+    # clusters).
+    Z = U.reshape(n, 2, kk)[:, 0] + 1j * U.reshape(n, 2, kk)[:, 1]
+    kept_vals, kept_vecs = [], []
+    for j in range(kk):
+        z = Z[:, j] / np.linalg.norm(Z[:, j])
+        for z0 in kept_vecs:
+            z = z - np.vdot(z0, z) * z0
+        r = np.linalg.norm(z)
+        if r < 0.3:
+            continue                       # complex-parallel J-copy
+        kept_vals.append(evals[j])
+        kept_vecs.append(z / r)
+        if len(kept_vals) == k:
+            break
+    if len(kept_vals) < k:
+        import warnings
+        warnings.warn(
+            f"pair-mode LOBPCG resolved only {len(kept_vals)} of {k} "
+            "distinct eigenpairs (unconverged tail); re-run with more "
+            "iterations or use solve.lanczos", RuntimeWarning)
+    return (np.asarray(kept_vals), np.stack(kept_vecs, axis=1),
+            int(iters))
