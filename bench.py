@@ -73,8 +73,20 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         y = eng._matvec(xj)[0]
     jax.block_until_ready(y)
     device_ms = (time.perf_counter() - t0) / repeats * 1e3
-    _progress(f"{name}: device {device_ms:.2f} ms/apply, host path next")
+    _progress(f"{name}: device {device_ms:.2f} ms/apply, k=2 batch next")
     y = np.asarray(y)
+
+    # k=2 batch: gathers [., 6]-wide split rows — near the single-vector row
+    # rate on v5e (tools/gather_bound.py), so per-vector cost ≈ halves.
+    X2 = jax.numpy.stack([xj, xj[::-1]], axis=1)
+    Y2 = jax.block_until_ready(eng._matvec(X2)[0])   # compile
+    t0 = time.perf_counter()
+    for _ in range(max(repeats // 2, 1)):
+        Y2 = eng._matvec(X2)[0]
+    jax.block_until_ready(Y2)
+    batch2_ms = (time.perf_counter() - t0) / max(repeats // 2, 1) * 1e3
+    _progress(f"{name}: k=2 batch {batch2_ms:.2f} ms "
+              f"({batch2_ms / 2:.2f} ms/vector), host path next")
 
     host_estimated = False
     if host_sample_rows is not None and host_sample_rows < n:
@@ -116,6 +128,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         "host_is_sampled_estimate": host_estimated,
         "speedup_vs_numpy": round(host_ms / device_ms, 2),
         "max_err_vs_host": err,
+        "batch2_ms_per_vector": round(batch2_ms / 2, 3),
     }
 
     if solver_iters:

@@ -58,11 +58,19 @@ def prep_gather(x, dtype, enabled: bool):
     When ``enabled``, ``x`` is pre-split once and every gather moves one
     ``[..., P]`` f32 row instead of an emulated-64-bit element (see module
     doc); otherwise the plain gather is returned.
+
+    Batched/pair vectors (trailing axes) are flattened so each gather moves
+    ONE contiguous ``[k·P]`` f32 row: on v5e the row-gather rate is flat up
+    to width ~6 (tools/gather_bound.py), so a k=2 batch costs nearly the
+    same as a single vector — XLA would otherwise issue separate gathers
+    per trailing-axis slice (measured 1.14× instead of ~2× per-vector).
     """
     if not enabled:
         return lambda i: x[i]
     xs = split_parts(x)
-    return lambda i: join_parts(xs[i], dtype)
+    tail = xs.shape[1:]
+    flat = xs.reshape(xs.shape[0], -1)
+    return lambda i: join_parts(flat[i].reshape(i.shape + tail), dtype)
 
 
 def _split3(x):

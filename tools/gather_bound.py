@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Measure the TPU gather roofline that bounds the ELL matvec.
+
+The symmetry-adapted SpMV is index-rate-bound: each of the ~N·T0 ELL
+entries costs one row gather of a [., 3] triple-f32 row (the exact f64
+split, ops/split_gather.py).  This script measures, on the current backend:
+
+  1. the raw row-gather rate vs table size, index locality, and row width;
+  2. the engine's realized rate on a real basis (gathers-only variant vs
+     the full matvec).
+
+Findings on TPU v5e (2026-07, this box; tunnel latency amortized by
+chaining CH applications inside one jitted program):
+
+  * rate is FLAT in index locality (random / sorted / banded / identity all
+    ~160-185 M rows/s at a 4.7M-row table) — a bandwidth-minimizing basis
+    reordering (RCM) cannot help, the cost is per-row, not per-page;
+  * width 3 (the triple-f32 split row) is the sweet spot: ~255 M rows/s at
+    2M rows; width 6 ≈ 0.8× the row rate (so pairing two vectors per gather
+    is a ~1.6× per-vector win for *block* solvers); width ≥ 12 collapses;
+  * Mosaic/Pallas cannot beat this: `tpu.dynamic_gather` only supports a
+    single-vreg (8×128) source ("Multiple source vregs along gather
+    dimension" is unimplemented), so no VMEM-blocked gather kernel exists
+    on this generation;
+  * chain_32_symm (N=4 707 969, T0=20 + tail): gathers alone are ~593 ms
+    of the ~660 ms apply — the engine runs at ≈93% of the gather roofline;
+    coefficient streams + f64 multiply-accumulate add only ~20 ms.
+
+Usage: python tools/gather_bound.py [--full]   (--full includes the
+4.7M-row chain_32_symm engine breakdown; several minutes of build time)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_matvec_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax                                             # noqa: E402
+import jax.numpy as jnp                                # noqa: E402
+
+CH = 10        # chained applications per jitted program (amortize latency)
+REPS = 3
+
+_latency_s = None
+
+
+def _fetch_latency() -> float:
+    """Measured per-call host-fetch round-trip (≈100 ms over the tunnel,
+    ~0 on a directly attached device), subtracted from each timing."""
+    global _latency_s
+    if _latency_s is None:
+        f = jax.jit(lambda a: a * 2.0)
+        s = np.asarray(f(jnp.float32(1.0)))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            s = np.asarray(f(jnp.float32(1.0)))
+        del s
+        _latency_s = (time.perf_counter() - t0) / 5
+    return _latency_s
+
+
+def _time_chain(ch, *args):
+    # NOTE: a host fetch (np.asarray), not block_until_ready — over the
+    # tunneled device the latter returns before execution completes and
+    # yields nonsense timings (measured)
+    s = np.asarray(jnp.sum(ch(*args)))
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        s = np.asarray(jnp.sum(ch(*args)))
+    del s
+    per = (time.perf_counter() - t0) / REPS - _fetch_latency()
+    return max(per, 1e-9) / CH
+
+
+def gather_rate(n_rows: int, width: int, pattern: str = "random") -> float:
+    """M rows/s for a [n_rows, width] f32 table under the index pattern."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((n_rows, width), dtype=np.float32))
+    g = n_rows
+    if pattern == "random":
+        ib = rng.integers(0, n_rows, g)
+    elif pattern == "sorted":
+        ib = np.sort(rng.integers(0, n_rows, g))
+    elif pattern == "identity":
+        ib = np.arange(g)
+    elif pattern == "banded":
+        ib = (np.arange(g) + rng.integers(-100_000, 100_000, g)) % n_rows
+    else:
+        raise ValueError(pattern)
+    ib = jnp.asarray(ib.astype(np.int32))
+
+    def chain(x, i):
+        acc = jnp.zeros((g, width), jnp.float32)
+        for k in range(CH):
+            acc = acc + x[(i + np.int32(k)) % np.int32(n_rows)]
+        return acc.sum()
+
+    dt = _time_chain(jax.jit(chain), x, ib)
+    return g / dt / 1e6
+
+
+def engine_breakdown():
+    """Gathers-only vs full matvec on the BASELINE headline basis."""
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.ops.split_gather import split_parts
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    n = 32
+    basis = SpinBasis(n, n // 2, 1,
+                      [([*range(1, n), 0], 0), ([*reversed(range(n))], 0)])
+    op = heisenberg_from_edges(basis, chain_edges(n))
+    print("building chain_32_symm basis + engine (minutes)...", flush=True)
+    basis.build()
+    eng = LocalEngine(op, mode="ell")
+    N, Npad, T0 = eng.n_states, eng.n_padded, eng._ell_T0
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(N))
+    x = x / jnp.linalg.norm(x)
+    apply_fn, operands = eng.bound_matvec()
+
+    def chain_full(x, ops):
+        for _ in range(CH):
+            x = apply_fn(x, ops)[0]
+        return x
+
+    full = _time_chain(jax.jit(chain_full), x, operands)
+
+    def gathers_only(x, ops):
+        idx = ops[0]
+        xs = split_parts(x)
+        acc = jnp.zeros((Npad, 3), jnp.float32)
+        for t in range(T0):
+            acc = acc + xs[idx[t]]
+        return acc.sum(axis=-1).astype(jnp.float64)
+
+    def chain_g(x, ops):
+        for _ in range(CH):
+            x = gathers_only(x, ops)[:N]
+        return x
+
+    g_only = _time_chain(jax.jit(chain_g), x, operands)
+    n_gathers = Npad * T0
+    print(f"chain_32_symm: N={N} T0={T0}  full {full*1e3:.0f} ms, "
+          f"gathers-only {g_only*1e3:.0f} ms "
+          f"({n_gathers/g_only/1e6:.0f} M rows/s; engine at "
+          f"{100*g_only/full:.0f}% gather share)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="include the chain_32_symm engine breakdown")
+    args = ap.parse_args()
+    print(f"backend: {jax.default_backend()}")
+
+    print("\n-- locality (4.7M-row [.,3] f32 table) --")
+    for pat in ("random", "sorted", "banded", "identity"):
+        print(f"  {pat:>9}: {gather_rate(4_718_592, 3, pat):6.0f} M rows/s")
+
+    print("\n-- row width (2M-row table, random) --")
+    for w in (3, 6, 12):
+        r = gather_rate(1 << 21, w)
+        print(f"  width {w:>2}: {r:6.0f} M rows/s = {r*w/1e3:5.1f} G elem/s")
+
+    if args.full:
+        print()
+        engine_breakdown()
+
+
+if __name__ == "__main__":
+    main()
