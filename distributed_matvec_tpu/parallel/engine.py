@@ -229,6 +229,19 @@ class LocalEngine:
 
     # -- structure build (ell mode) -----------------------------------------
 
+    def _chunk_structure(self, tables, pair, dir_tab, alphas, norms_a):
+        """Shared device pass for one row chunk: kernels → basis lookup →
+        masking.  Returns (idx [B,T] i32-able, coeff [B,T(,2)], invalid) —
+        the single source of truth for the one-pass build, the two-pass
+        build, and the fused matvec."""
+        betas, cf = K.gather_coefficients(tables, alphas, norms_a)
+        idx, found = state_index_bucketed(
+            pair, dir_tab, betas.reshape(-1),
+            shift=self._lk_shift, probes=self._lk_probes)
+        return K.mask_structure(
+            cf, idx.reshape(betas.shape), found.reshape(betas.shape),
+            alphas != SENTINEL_STATE)
+
     def _build_ell(self) -> None:
         """One device pass of the kernels → static [N_pad, T] idx/coeff.
 
@@ -245,19 +258,24 @@ class LocalEngine:
         alphas_c = self._alphas.reshape(C, b)
         norms_c = self._norms.reshape(C, b)
         T = self.num_terms
-        lk_shift, lk_probes = self._lk_shift, self._lk_probes
         is_pair = self.pair
+
+        # One-pass build materializes full-width [T, N_pad] idx+coeff buffers
+        # before packing (peak ≈ 1.6× their size).  When that exceeds the
+        # device budget, fall back to the two-pass build: count, then pack
+        # chunk-by-chunk straight into the final buffers.
+        cf_item = 8 if (self.real and not is_pair) else 16
+        full_bytes = self.n_padded * T * (4 + cf_item)
+        if 1.6 * full_bytes > get_config().ell_build_budget_gb * 1e9:
+            log_debug(f"ell build: two-pass low-memory path "
+                      f"(full-width {full_bytes/1e9:.1f} GB)")
+            return self._build_ell_lowmem()
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def fill_chunk(idx_buf, coeff_buf, bad, tables, pair, dir_tab,
                        alphas, norms_a, start):
-            betas, cf = K.gather_coefficients(tables, alphas, norms_a)
-            idx, found = state_index_bucketed(
-                pair, dir_tab, betas.reshape(-1),
-                shift=lk_shift, probes=lk_probes)
-            idx, cf, invalid = K.mask_structure(
-                cf, idx.reshape(betas.shape), found.reshape(betas.shape),
-                alphas != SENTINEL_STATE)
+            idx, cf, invalid = self._chunk_structure(tables, pair, dir_tab,
+                                                     alphas, norms_a)
             # Transposed [T, N_pad(, 2)] layout: the matvec walks terms
             # outermost, so per-term rows are contiguous (measured ~2× over
             # [N_pad, T] + axis-1 reduce on v5e).
@@ -384,6 +402,122 @@ class LocalEngine:
 
         self._ell_tail = build_tail(idx_buf, coeff_buf, nnz)
 
+    def _build_ell_lowmem(self) -> None:
+        """Two-pass ELL build bounded by the *packed* table size.
+
+        Pass 1 runs the kernels chunk-by-chunk and keeps only per-row nnz
+        counts (a [b] vector per chunk) to build the global histogram; pass 2
+        re-runs the kernels and packs each chunk's nonzeros directly into the
+        donated final [T0, N_pad] buffers plus a sequentially-assembled tail.
+        The kernels run twice, but peak device memory is the packed output +
+        O(b·T) chunk scratch instead of the full-width [T, N_pad] tables —
+        what makes square_6x6 (N=15.8M, T=72: 13.7 GB full-width vs ~7 GB
+        packed) buildable on one 16 GB chip.
+
+        Tail assembly invariant: chunk k writes a fixed-capacity [Ct] slab at
+        host-computed offset o_k = Σ_{j<k} real_j; the slab's garbage rows
+        beyond real_k are exactly covered by chunk k+1's slab (o_{k+1} =
+        o_k + real_k, same capacity), and the final chunk's garbage lies in
+        [S, S+Ct), sliced off — so after the sequential sweep positions
+        [0, S) hold exactly the real tail rows.
+        """
+        b, C = self.batch_size, self.num_chunks
+        alphas_c = self._alphas.reshape(C, b)
+        norms_c = self._norms.reshape(C, b)
+        T = self.num_terms
+        n_pad = self.n_padded
+        is_pair = self.pair
+        cdtype = jnp.float64 if (self.real or is_pair) else jnp.complex128
+        pz = ((2,) if is_pair else ())
+
+        def dead(cf):
+            return (cf == 0).all(axis=-1) if is_pair else (cf == 0)
+
+        # -- pass 1: histogram of row-nnz ---------------------------------
+        @jax.jit
+        def count_chunk(tables, pair, dir_tab, alphas, norms_a):
+            idx, cf, invalid = self._chunk_structure(tables, pair, dir_tab,
+                                                     alphas, norms_a)
+            return (~dead(jnp.moveaxis(cf, 0, 1))).sum(axis=0), invalid
+
+        hist = np.zeros(T + 1, np.int64)
+        nnz_chunks = []
+        bad = 0
+        for ci in range(C):
+            log_debug(f"ell lowmem count chunk {ci}/{C}")
+            nnz, invalid = count_chunk(self.tables, self._lk_pair,
+                                       self._lk_dir, alphas_c[ci],
+                                       norms_c[ci])
+            nnz = np.asarray(nnz)
+            bad += int(invalid)
+            hist += np.bincount(nnz, minlength=T + 1)
+            nnz_chunks.append(nnz)
+        if bad:
+            raise RuntimeError(
+                f"{bad} generated matrix elements map outside the basis "
+                "— operator does not preserve the chosen sector"
+            )
+
+        T0, S, Tmax = choose_ell_split(hist, n_pad, T,
+                                       real_rows=self.n_states)
+        self._ell_T0 = T0
+        log_debug(f"ell lowmem split: T={T} Tmax={Tmax} T0={T0} "
+                  f"tail_rows={S}")
+        Tw = Tmax - T0 if S else 0
+        tail_counts = [int((nnz > T0).sum()) for nnz in nnz_chunks] if S \
+            else [0] * C
+        Ct = max(tail_counts) if S else 0
+        offs = np.concatenate([[0], np.cumsum(tail_counts)])
+
+        # -- pass 2: pack into donated final buffers ----------------------
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def pack_chunk(out_idx, out_cf, t_rows, t_idx, t_cf, tables, pair,
+                       dir_tab, alphas, norms_a, start, toff):
+            idx, cf, _ = self._chunk_structure(tables, pair, dir_tab,
+                                               alphas, norms_a)
+            idx_t = idx.T.astype(jnp.int32)           # [T, b]
+            cf_t = jnp.moveaxis(cf, 0, 1)             # [T, b(, 2)]
+            dm = dead(cf_t)
+            order = jnp.argsort(dm, axis=0, stable=True)
+            idx_p = jnp.take_along_axis(idx_t, order, axis=0)
+            cf_p = jnp.take_along_axis(
+                cf_t, order[..., None] if is_pair else order, axis=0)
+            zero = jnp.zeros((), start.dtype)
+            out_idx = jax.lax.dynamic_update_slice(
+                out_idx, idx_p[:T0], (zero, start))
+            out_cf = jax.lax.dynamic_update_slice(
+                out_cf, cf_p[:T0], (zero, start) + ((zero,) if is_pair
+                                                    else ()))
+            if Ct:
+                nnzc = (~dm).sum(axis=0)              # [b]
+                tr = jnp.nonzero(nnzc > T0, size=Ct, fill_value=0)[0]
+                tr = tr.astype(jnp.int32)
+                t_rows = jax.lax.dynamic_update_slice(
+                    t_rows, tr + start, (toff,))
+                t_idx = jax.lax.dynamic_update_slice(
+                    t_idx, idx_p[T0:Tmax][:, tr], (zero, toff))
+                t_cf = jax.lax.dynamic_update_slice(
+                    t_cf, cf_p[T0:Tmax][:, tr],
+                    (zero, toff) + ((zero,) if is_pair else ()))
+            return out_idx, out_cf, t_rows, t_idx, t_cf
+
+        out_idx = jnp.zeros((T0, n_pad), jnp.int32)
+        out_cf = jnp.zeros((T0, n_pad) + pz, cdtype)
+        S_buf = S + Ct
+        t_rows = jnp.zeros(max(S_buf, 1), jnp.int32)
+        t_idx = jnp.zeros((max(Tw, 1), max(S_buf, 1)), jnp.int32)
+        t_cf = jnp.zeros((max(Tw, 1), max(S_buf, 1)) + pz, cdtype)
+        for ci in range(C):
+            log_debug(f"ell lowmem pack chunk {ci}/{C}")
+            out_idx, out_cf, t_rows, t_idx, t_cf = pack_chunk(
+                out_idx, out_cf, t_rows, t_idx, t_cf, self.tables,
+                self._lk_pair, self._lk_dir, alphas_c[ci], norms_c[ci],
+                jnp.int32(ci * b), jnp.int32(offs[ci]))
+        self._ell_idx = out_idx
+        self._ell_coeff = out_cf
+        self._ell_tail = None if S == 0 else (
+            t_rows[:S], t_idx[:, :S], t_cf[:, :S])
+
     def _make_ell_matvec(self):
         n = self.n_states
         T0 = self._ell_T0
@@ -443,7 +577,6 @@ class LocalEngine:
         n, b, C = self.n_states, self.batch_size, self.num_chunks
         dtype = self._dtype
         use_sg = split_gather_enabled()
-        lk_shift, lk_probes = self._lk_shift, self._lk_probes
         is_pair = self.pair
         nd_base = 2 if is_pair else 1
 
@@ -455,13 +588,8 @@ class LocalEngine:
 
             def chunk(args):
                 alphas, norms_a = args
-                betas, coeff = K.gather_coefficients(tables, alphas, norms_a)
-                idx, found = state_index_bucketed(
-                    pair, dir_tab, betas.reshape(-1),
-                    shift=lk_shift, probes=lk_probes)
-                idx, coeff, invalid = K.mask_structure(
-                    coeff, idx.reshape(betas.shape),
-                    found.reshape(betas.shape), alphas != SENTINEL_STATE)
+                idx, coeff, invalid = self._chunk_structure(
+                    tables, pair, dir_tab, alphas, norms_a)
                 g = gx(idx)                      # [B, T] + x.shape[1:]
                 if is_pair:
                     cb = coeff[:, :, None, :] if batched else coeff

@@ -36,6 +36,12 @@ class RuntimeConfig:
 
     # -- device/layout ------------------------------------------------------
     matvec_batch_size: int = 1 << 16       # row block B fed to the off-diag kernel
+    ell_build_budget_gb: float = 12.0      # device-memory budget for the ELL
+    #   structure build; when the one-pass build's full-width [T, N_pad]
+    #   buffers would exceed it, the engine switches to the two-pass
+    #   low-memory build (count → pack), enabling ELL for bases like
+    #   square_6x6 whose packed tables fit HBM but whose full-width
+    #   intermediates do not
     matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
