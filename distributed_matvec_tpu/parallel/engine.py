@@ -166,7 +166,7 @@ class LocalEngine:
             basis.build()
         cfg = get_config()
         mode = mode or cfg.matvec_mode
-        if mode not in ("ell", "fused"):
+        if mode not in ("ell", "fused", "compact"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:
             raise ValueError(
@@ -221,6 +221,11 @@ class LocalEngine:
             with self.timer.scope("build_structure"):
                 self._build_ell()
             self._matvec = self._make_ell_matvec()
+            self._checked = True                  # validated at build time
+        elif mode == "compact":
+            with self.timer.scope("build_structure"):
+                self._build_compact()
+            self._matvec = self._make_compact_matvec()
             self._checked = True                  # validated at build time
         else:
             self._matvec = self._make_fused_matvec()
@@ -402,6 +407,61 @@ class LocalEngine:
 
         self._ell_tail = build_tail(idx_buf, coeff_buf, nnz)
 
+    def _count_row_nnz(self, alphas_c, norms_c):
+        """Counting pass shared by the low-memory builds: per-chunk row-nnz
+        vectors plus the global histogram, keeping only O(b) state per chunk.
+        Raises on out-of-basis targets (the build-time halt)."""
+        T = self.num_terms
+        is_pair = self.pair
+
+        @jax.jit
+        def count_chunk(tables, pair, dir_tab, alphas, norms_a):
+            idx, cf, invalid = self._chunk_structure(tables, pair, dir_tab,
+                                                     alphas, norms_a)
+            live = (cf != 0).any(axis=-1) if is_pair else (cf != 0)
+            return live.sum(axis=1), invalid
+
+        hist = np.zeros(T + 1, np.int64)
+        nnz_chunks = []
+        bad = 0
+        C = alphas_c.shape[0]
+        for ci in range(C):
+            log_debug(f"ell count chunk {ci}/{C}")
+            nnz, invalid = count_chunk(self.tables, self._lk_pair,
+                                       self._lk_dir, alphas_c[ci],
+                                       norms_c[ci])
+            nnz = np.asarray(nnz)
+            bad += int(invalid)
+            hist += np.bincount(nnz, minlength=T + 1)
+            nnz_chunks.append(nnz)
+        if bad:
+            raise RuntimeError(
+                f"{bad} generated matrix elements map outside the basis "
+                "— operator does not preserve the chosen sector"
+            )
+        return hist, nnz_chunks
+
+    @staticmethod
+    def _tail_layout(nnz_chunks, T0, S, Tmax):
+        """Tail bookkeeping shared by the chunked pack loops (low-memory ELL
+        and compact builds).
+
+        Tail slabs are written sequentially with one fixed capacity ``Ct``:
+        chunk k writes at host offset ``offs[k] = Σ_{j<k} real_j``, so a
+        slab's garbage rows beyond its real count are exactly covered by
+        chunk k+1's slab (same capacity, offset advanced by real_k), and the
+        final chunk's garbage lies in [S, S+Ct) — sliced off by the caller.
+        After the sweep, positions [0, S) hold exactly the real tail rows.
+        Returns ``(Tw, Ct, offs)``.
+        """
+        C = len(nnz_chunks)
+        Tw = Tmax - T0 if S else 0
+        tail_counts = [int((z > T0).sum()) for z in nnz_chunks] if S \
+            else [0] * C
+        Ct = max(tail_counts) if S else 0
+        offs = np.concatenate([[0], np.cumsum(tail_counts)])
+        return Tw, Ct, offs
+
     def _build_ell_lowmem(self) -> None:
         """Two-pass ELL build bounded by the *packed* table size.
 
@@ -412,14 +472,8 @@ class LocalEngine:
         The kernels run twice, but peak device memory is the packed output +
         O(b·T) chunk scratch instead of the full-width [T, N_pad] tables —
         what makes square_6x6 (N=15.8M, T=72: 13.7 GB full-width vs ~7 GB
-        packed) buildable on one 16 GB chip.
-
-        Tail assembly invariant: chunk k writes a fixed-capacity [Ct] slab at
-        host-computed offset o_k = Σ_{j<k} real_j; the slab's garbage rows
-        beyond real_k are exactly covered by chunk k+1's slab (o_{k+1} =
-        o_k + real_k, same capacity), and the final chunk's garbage lies in
-        [S, S+Ct), sliced off — so after the sequential sweep positions
-        [0, S) hold exactly the real tail rows.
+        packed) buildable on one 16 GB chip.  Tail slabs are assembled
+        sequentially per the invariant documented in :meth:`_tail_layout`.
         """
         b, C = self.batch_size, self.num_chunks
         alphas_c = self._alphas.reshape(C, b)
@@ -433,41 +487,14 @@ class LocalEngine:
         def dead(cf):
             return (cf == 0).all(axis=-1) if is_pair else (cf == 0)
 
-        # -- pass 1: histogram of row-nnz ---------------------------------
-        @jax.jit
-        def count_chunk(tables, pair, dir_tab, alphas, norms_a):
-            idx, cf, invalid = self._chunk_structure(tables, pair, dir_tab,
-                                                     alphas, norms_a)
-            return (~dead(jnp.moveaxis(cf, 0, 1))).sum(axis=0), invalid
-
-        hist = np.zeros(T + 1, np.int64)
-        nnz_chunks = []
-        bad = 0
-        for ci in range(C):
-            log_debug(f"ell lowmem count chunk {ci}/{C}")
-            nnz, invalid = count_chunk(self.tables, self._lk_pair,
-                                       self._lk_dir, alphas_c[ci],
-                                       norms_c[ci])
-            nnz = np.asarray(nnz)
-            bad += int(invalid)
-            hist += np.bincount(nnz, minlength=T + 1)
-            nnz_chunks.append(nnz)
-        if bad:
-            raise RuntimeError(
-                f"{bad} generated matrix elements map outside the basis "
-                "— operator does not preserve the chosen sector"
-            )
+        hist, nnz_chunks = self._count_row_nnz(alphas_c, norms_c)
 
         T0, S, Tmax = choose_ell_split(hist, n_pad, T,
                                        real_rows=self.n_states)
         self._ell_T0 = T0
         log_debug(f"ell lowmem split: T={T} Tmax={Tmax} T0={T0} "
                   f"tail_rows={S}")
-        Tw = Tmax - T0 if S else 0
-        tail_counts = [int((nnz > T0).sum()) for nnz in nnz_chunks] if S \
-            else [0] * C
-        Ct = max(tail_counts) if S else 0
-        offs = np.concatenate([[0], np.cumsum(tail_counts)])
+        Tw, Ct, offs = self._tail_layout(nnz_chunks, T0, S, Tmax)
 
         # -- pass 2: pack into donated final buffers ----------------------
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
@@ -517,6 +544,182 @@ class LocalEngine:
         self._ell_coeff = out_cf
         self._ell_tail = None if S == 0 else (
             t_rows[:S], t_idx[:, :S], t_cf[:, :S])
+
+    def _build_compact(self) -> None:
+        """4-bytes-per-entry structure for real sectors with one off-diagonal
+        magnitude W (isotropic Heisenberg: every ⟨β|H|α⟩ is ±2J).
+
+        The projected coefficient is then fully derivable at matvec time:
+        ``A[i, j] = W · s · n(j)/n(i)`` with s = ±1 — so each entry stores
+        ONLY a sign-tagged index ``±(idx+1)`` (0 = no element) and the matvec
+        gathers n(j) alongside x(j) in one split row.  This fits bases whose
+        standard 12 B/entry tables exceed HBM: chain_36_symm (63M states,
+        the config behind the reference's published OpenMP numbers,
+        example/Example05.chpl:97-99) needs ~15 GB standard but ~5 GB
+        compact.  W is sample-derived and every entry is validated during
+        the build (a ratio violation fails loudly — anisotropic couplings
+        must use mode='ell').
+        """
+        if not self.real or self.pair:
+            raise ValueError(
+                "compact mode requires a real sector (use mode='ell' for "
+                "complex-character momentum sectors)")
+        b, C = self.batch_size, self.num_chunks
+        alphas_c = self._alphas.reshape(C, b)
+        norms_c = self._norms.reshape(C, b)
+        T = self.num_terms
+        n_pad = self.n_padded
+        n = self.n_states
+
+        sample = self.operator.basis.representatives[: min(n, 4096)]
+        _, amps = self.operator.apply_off_diag(sample)
+        vals = np.unique(np.abs(amps[amps != 0]))
+        if vals.size != 1:
+            raise ValueError(
+                f"compact mode needs a single off-diagonal magnitude, "
+                f"found {vals[:5]}; use mode='ell'")
+        W = float(vals[0])
+        self._c_W = W
+
+        hist, nnz_chunks = self._count_row_nnz(alphas_c, norms_c)
+        T0, S, Tmax = choose_ell_split(hist, n_pad, T, real_rows=n)
+        self._ell_T0 = T0
+        log_debug(f"compact split: T={T} Tmax={Tmax} T0={T0} tail_rows={S}")
+        Tw, Ct, offs = self._tail_layout(nnz_chunks, T0, S, Tmax)
+        norms_dev = jnp.asarray(self.operator.basis.norms)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def pack_chunk(out_idx, t_rows, t_idx, bad_ratio, tables, pair,
+                       dir_tab, alphas, norms_a, nrm_full, start, toff):
+            idx, cf, _ = self._chunk_structure(tables, pair, dir_tab,
+                                               alphas, norms_a)
+            nz = cf != 0
+            # validate coeff == ±W·n(j)/n(i) for every nonzero entry
+            nb = nrm_full[idx]
+            ratio = jnp.abs(cf) * norms_a[:, None] / jnp.where(nb > 0, nb, 1)
+            bad_ratio = bad_ratio + jnp.sum(
+                nz & (jnp.abs(ratio - W) > 1e-9 * W))
+            sgn = jnp.where(cf >= 0, 1, -1).astype(jnp.int32)
+            tag = jnp.where(nz, sgn * (idx.astype(jnp.int32) + 1), 0)
+            tag_t = tag.T                           # [T, b]
+            order = jnp.argsort(tag_t == 0, axis=0, stable=True)
+            tag_p = jnp.take_along_axis(tag_t, order, axis=0)
+            zero = jnp.zeros((), start.dtype)
+            out_idx = jax.lax.dynamic_update_slice(
+                out_idx, tag_p[:T0], (zero, start))
+            if Ct:
+                nnzc = (tag_t != 0).sum(axis=0)
+                tr = jnp.nonzero(nnzc > T0, size=Ct,
+                                 fill_value=0)[0].astype(jnp.int32)
+                t_rows = jax.lax.dynamic_update_slice(
+                    t_rows, tr + start, (toff,))
+                t_idx = jax.lax.dynamic_update_slice(
+                    t_idx, tag_p[T0:Tmax][:, tr], (zero, toff))
+            return out_idx, t_rows, t_idx, bad_ratio
+
+        out_idx = jnp.zeros((T0, n_pad), jnp.int32)
+        S_buf = S + Ct
+        t_rows = jnp.zeros(max(S_buf, 1), jnp.int32)
+        t_idx = jnp.zeros((max(Tw, 1), max(S_buf, 1)), jnp.int32)
+        bad_ratio = jnp.zeros((), jnp.int64)
+        for ci in range(C):
+            log_debug(f"compact pack chunk {ci}/{C}")
+            out_idx, t_rows, t_idx, bad_ratio = pack_chunk(
+                out_idx, t_rows, t_idx, bad_ratio, self.tables,
+                self._lk_pair, self._lk_dir, alphas_c[ci], norms_c[ci],
+                norms_dev, jnp.int32(ci * b), jnp.int32(offs[ci]))
+        if int(bad_ratio):
+            raise RuntimeError(
+                f"{int(bad_ratio)} matrix elements violate the "
+                f"±W·n(j)/n(i) form (W={W}); the operator does not qualify "
+                "for compact mode — use mode='ell'"
+            )
+        self._c_idx = out_idx
+        self._c_tail = None if S == 0 else (t_rows[:S], t_idx[:, :S])
+        inv_n = np.ones(n_pad)
+        nrm_host = np.asarray(self.operator.basis.norms)
+        inv_n[:n] = 1.0 / nrm_host
+        self._c_inv_n = jnp.asarray(inv_n)
+        # keep only the norm table the selected gather path reads (the other
+        # would be dead HBM in a mode whose whole point is headroom)
+        from ..ops.split_gather import split_parts
+        self._c_use_sg = split_gather_enabled()
+        if self._c_use_sg:
+            self._c_n_parts = jax.jit(split_parts)(norms_dev)   # [n, 3] f32
+            self._c_norms = jnp.zeros(0)
+        else:
+            self._c_n_parts = jnp.zeros((0, 3), jnp.float32)
+            self._c_norms = norms_dev
+
+    def _make_compact_matvec(self):
+        n = self.n_states
+        T0 = self._ell_T0
+        W = self._c_W
+        has_tail = self._c_tail is not None
+        use_sg = self._c_use_sg   # decided at build (only one table kept)
+
+        from ..ops.split_gather import join_parts, split_parts
+
+        def apply_fn(x, operands):
+            idxt, diag, inv_n, n_parts, norms_plain, tail = operands
+            x = jnp.asarray(x).astype(jnp.float64)
+            batched = x.ndim == 2
+
+            if use_sg:
+                # one [3k+3]-wide f32 row per gather: x parts then n parts
+                xs = split_parts(x).reshape(x.shape[0], -1)
+                kx = xs.shape[1]
+                src = jnp.concatenate([xs, n_parts], axis=1)
+
+                def gather_nx(i):
+                    g = src[i]
+                    xg = join_parts(
+                        g[..., :kx].reshape(i.shape + x.shape[1:] + (3,)),
+                        jnp.float64)
+                    ng = join_parts(g[..., kx:], jnp.float64)
+                    return xg, ng
+            else:
+                def gather_nx(i):
+                    return x[i], norms_plain[i]
+
+            def terms(acc, idxt, width):
+                def body(acc, v):
+                    i = jnp.maximum(jnp.abs(v) - 1, 0)
+                    s = jnp.sign(v).astype(jnp.float64)
+                    xg, ng = gather_nx(i)
+                    w = s * ng
+                    return acc + (w[:, None] if batched else w) * xg
+
+                if width <= 64:
+                    for t in range(width):
+                        acc = body(acc, idxt[t])
+                else:
+                    acc, _ = jax.lax.scan(
+                        lambda a, v: (body(a, v), None), acc, idxt[:width])
+                return acc
+
+            acc = terms(jnp.zeros((idxt.shape[1],) + x.shape[1:]),
+                        idxt, T0)[:n]
+            d = diag[:n]
+            scale = W * inv_n[:n]
+            if batched:
+                y = d[:, None] * x + scale[:, None] * acc
+            else:
+                y = d * x + scale * acc
+            if has_tail:
+                rows, idx_t = tail
+                acc_t = terms(jnp.zeros(rows.shape + x.shape[1:]),
+                              idx_t, idx_t.shape[0])
+                sc = W * inv_n[rows]
+                y = y.at[rows].add(
+                    (sc[:, None] if batched else sc) * acc_t, mode="drop")
+            return y, jnp.zeros((), jnp.int64)
+
+        self._apply_fn = apply_fn
+        self._operands = (self._c_idx, self._diag, self._c_inv_n,
+                          self._c_n_parts, self._c_norms, self._c_tail)
+        _mv = jax.jit(apply_fn)
+        return lambda x: _mv(x, self._operands)
 
     def _make_ell_matvec(self):
         n = self.n_states
@@ -665,6 +868,12 @@ class LocalEngine:
     @property
     def ell_nbytes(self) -> int:
         """Device memory held by the precomputed structure (0 in fused mode)."""
+        if self.mode == "compact":
+            total = (self._c_idx.nbytes + self._c_n_parts.nbytes
+                     + self._c_norms.nbytes + self._c_inv_n.nbytes)
+            if self._c_tail is not None:
+                total += sum(a.nbytes for a in self._c_tail)
+            return total
         if self.mode != "ell":
             return 0
         total = self._ell_idx.nbytes + self._ell_coeff.nbytes

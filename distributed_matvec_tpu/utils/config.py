@@ -42,7 +42,8 @@ class RuntimeConfig:
     #   low-memory build (count → pack), enabling ELL for bases like
     #   square_6x6 whose packed tables fit HBM but whose full-width
     #   intermediates do not
-    matvec_mode: str = "ell"               # "ell" (precomputed structure) | "fused"
+    matvec_mode: str = "ell"               # "ell" (precomputed structure) |
+    #   "compact" (4 B/entry, isotropic real sectors) | "fused" (recompute)
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
     complex_pair: str = "auto"             # (re,im)-f64 pair engines for

@@ -159,6 +159,55 @@ def test_lowmem_build_matches_onepass(rng):
         np.testing.assert_array_equal(y_ref, y_lm)
 
 
+def test_compact_mode_matches_dense(rng):
+    """compact mode (sign-tagged 4 B/entry, coefficients derived as
+    W·s·n(j)/n(i) at matvec time) matches the dense reference for isotropic
+    Heisenberg sectors, rank-1 and rank-2, both gather paths."""
+    from distributed_matvec_tpu.utils.config import get_config, update_config
+
+    prev = get_config().split_gather
+    op = build_heisenberg(12, 6, 1,
+                          [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 0),
+                           ([11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1, 0], 0)])
+    op.basis.build()
+    h = dense_effective_matrix(op)
+    N = op.basis.number_states
+    x = rng.random(N) - 0.5
+    X = rng.random((N, 3)) - 0.5
+    try:
+        for sg in ("off", "on"):
+            update_config(split_gather=sg)
+            eng = LocalEngine(op, batch_size=61, mode="compact")
+            np.testing.assert_allclose(np.asarray(eng.matvec(x)), h @ x,
+                                       atol=1e-13, rtol=1e-12)
+            np.testing.assert_allclose(np.asarray(eng.matvec(X)), h @ X,
+                                       atol=1e-13, rtol=1e-12)
+    finally:
+        update_config(split_gather=prev)
+
+
+def test_compact_mode_refusals():
+    """compact mode must refuse anisotropic couplings (several off-diagonal
+    magnitudes) and complex-character sectors."""
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+
+    b = SpinBasis(8, 4)
+    op = heisenberg_from_edges(b, chain_edges(8)) \
+        + 0.44 * heisenberg_from_edges(b, [(i, (i + 2) % 8)
+                                           for i in range(8)])
+    b.build()
+    with pytest.raises(ValueError, match="single off-diagonal magnitude"):
+        LocalEngine(op, mode="compact")
+
+    b2 = SpinBasis(10, 5, None, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 0], 1)])
+    op2 = heisenberg_from_edges(b2, chain_edges(10))
+    b2.build()
+    with pytest.raises(ValueError, match="real sector"):
+        LocalEngine(op2, mode="compact")
+
+
 def test_ell_split_cost_model_properties():
     """choose_ell_split: scatter-heavy layouts are rejected, truncation-only
     wins are kept, and degenerate histograms fall back to the full table."""

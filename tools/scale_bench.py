@@ -9,7 +9,8 @@ on whatever backend is default:
 
   1. enumerate representatives (native C++ streaming kernel), checkpointing
      them into an HDF5 file so a rerun skips straight to the compute;
-  2. build the jitted engine (ELL if the tables fit, else fused);
+  2. build the jitted engine (ELL if the packed tables fit, else compact
+     4 B/entry for qualifying isotropic sectors, else fused);
   3. time the steady-state matvec and a few Lanczos iterations.
 
 Prints one JSON line per phase.  Usage:
@@ -41,7 +42,8 @@ def main():
     ap.add_argument("--config", default="heisenberg_square_6x6.yaml")
     ap.add_argument("--out", default="/tmp/scale_square_6x6.h5",
                     help="representative checkpoint (HDF5)")
-    ap.add_argument("--mode", default=None, choices=(None, "ell", "fused"))
+    ap.add_argument("--mode", default=None,
+                    choices=(None, "ell", "compact", "fused"))
     ap.add_argument("--solver-iters", type=int, default=8)
     args = ap.parse_args()
 
@@ -66,14 +68,25 @@ def main():
     # Packed-ELL estimate: (i32 idx + f64 coeff) · N · T0, with the typical
     # ~0.55 fill after the two-level split.  The two-pass low-memory build
     # (LocalEngine._build_ell_lowmem) keeps the build peak at packed size,
-    # so the packed estimate — not the full-width one — gates ELL.
+    # so the packed estimate — not the full-width one — gates ELL.  Beyond
+    # that, "compact" (4 B/entry sign-tagged indices, isotropic sectors
+    # only) stretches ~3× further; fused is the unbounded fallback.
     est_gb = n * T * 12 * 0.65 / 1e9
-    mode = args.mode or ("ell" if est_gb < 10.0 else "fused")
+    mode = args.mode or ("ell" if est_gb < 10.0 else "compact")
     log("engine_select", num_terms=T, est_packed_ell_gb=round(est_gb, 2),
         mode=mode)
 
     t0 = time.time()
-    eng = LocalEngine(op, mode=mode)
+    try:
+        eng = LocalEngine(op, mode=mode)
+    except (ValueError, RuntimeError) as e:
+        # compact refuses up front (ValueError) or after full build-time
+        # ratio validation (RuntimeError) — fall back to fused either way
+        if mode != "compact":
+            raise
+        log("engine_fallback", reason=str(e)[:120])
+        mode = "fused"
+        eng = LocalEngine(op, mode=mode)
     log("engine_build", seconds=round(time.time() - t0, 1),
         ell_gb=round(eng.ell_nbytes / 1e9, 2),
         backend=jax.default_backend())
