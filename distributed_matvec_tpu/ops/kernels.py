@@ -59,6 +59,12 @@ _U = jnp.uint64
 # does on the host, or the engine build flags phantom out-of-basis targets.
 _NORM2_TOL = _CHAR_TOL
 
+# state_info unrolls the per-coset orbit scan for at most this many cosets;
+# beyond it (2-D translation groups + point group) a dynamic fori_loop keeps
+# the XLA program O(Sc+P) instead of O(J·(Sc+P)) — a J=48 unroll was observed
+# to hang the TPU compiler for >35 min.
+_COSET_UNROLL_MAX = 8
+
 
 # ---------------------------------------------------------------------------
 # (re, im) pair representation of complex values
@@ -324,7 +330,8 @@ def state_info(g: GroupTables, states: jax.Array):
     izero = (flat ^ flat).astype(jnp.int32)
     carry = (flat + jnp.uint64(0),  # identity (elem index 0); re-updated below
              izero, zero)
-    for j in range(J):  # few cosets — unrolled
+
+    def one_coset(j, carry):
         z = apply_coset_rep(j, flat)
         carry = update(carry, z, g.elem[j, 0])
 
@@ -335,7 +342,17 @@ def state_info(g: GroupTables, states: jax.Array):
             return best, gidx, stab, z
 
         best, gidx, stab, _ = jax.lax.fori_loop(1, P, body, carry + (z,))
-        carry = (best, gidx, stab)
+        return best, gidx, stab
+
+    if J <= _COSET_UNROLL_MAX:
+        # few cosets — unrolled (cheapest compile, constant-folds g.elem)
+        for j in range(J):
+            carry = one_coset(j, carry)
+    else:
+        # many cosets (2-D translation groups + point group: square_6x6 has
+        # J=48) — a Python unroll makes the XLA program O(J·(Sc+P)) and the
+        # compile pathological (>35 min observed); loop dynamically instead
+        carry = jax.lax.fori_loop(0, J, one_coset, carry)
     best, gidx, stab = carry
     char = g.char_conj[gidx]
     norm2 = stab / G

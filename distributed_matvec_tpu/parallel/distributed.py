@@ -102,7 +102,18 @@ class DistributedEngine:
         if not basis.is_built:
             basis.build()
         cfg = get_config()
-        mode = mode or cfg.matvec_mode
+        if mode is None:
+            mode = cfg.matvec_mode
+            if mode == "compact":
+                # the global knob may be tuned for LocalEngine runs; fall
+                # back rather than fail a consumer that never supported it
+                log_debug("compact mode is single-device only; "
+                          "DistributedEngine falls back to 'ell'")
+                mode = "ell"
+        if mode == "compact":
+            raise ValueError(
+                "compact mode is single-device only (LocalEngine); use "
+                "'ell' or 'fused' for DistributedEngine")
         if mode not in ("ell", "fused"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:

@@ -640,16 +640,15 @@ class LocalEngine:
         nrm_host = np.asarray(self.operator.basis.norms)
         inv_n[:n] = 1.0 / nrm_host
         self._c_inv_n = jnp.asarray(inv_n)
-        # keep only the norm table the selected gather path reads (the other
-        # would be dead HBM in a mode whose whole point is headroom)
+        # split-gather path keeps an [n, 3] f32 norm table; the plain path
+        # gathers from the already-resident padded self._norms instead (no
+        # extra HBM in a mode whose whole point is headroom)
         from ..ops.split_gather import split_parts
         self._c_use_sg = split_gather_enabled()
         if self._c_use_sg:
             self._c_n_parts = jax.jit(split_parts)(norms_dev)   # [n, 3] f32
-            self._c_norms = jnp.zeros(0)
         else:
             self._c_n_parts = jnp.zeros((0, 3), jnp.float32)
-            self._c_norms = norms_dev
 
     def _make_compact_matvec(self):
         n = self.n_states
@@ -717,7 +716,7 @@ class LocalEngine:
 
         self._apply_fn = apply_fn
         self._operands = (self._c_idx, self._diag, self._c_inv_n,
-                          self._c_n_parts, self._c_norms, self._c_tail)
+                          self._c_n_parts, self._norms, self._c_tail)
         _mv = jax.jit(apply_fn)
         return lambda x: _mv(x, self._operands)
 
@@ -870,7 +869,7 @@ class LocalEngine:
         """Device memory held by the precomputed structure (0 in fused mode)."""
         if self.mode == "compact":
             total = (self._c_idx.nbytes + self._c_n_parts.nbytes
-                     + self._c_norms.nbytes + self._c_inv_n.nbytes)
+                     + self._c_inv_n.nbytes)
             if self._c_tail is not None:
                 total += sum(a.nbytes for a in self._c_tail)
             return total

@@ -191,3 +191,31 @@ def test_operator_algebra_names():
     assert (a - b).name == "a - b"
     assert (2.0 * a).name == "2.0·a"
     assert (-a).name == "-a"
+
+
+def test_state_info_coset_loop_paths_agree(monkeypatch, rng):
+    """The unrolled (J ≤ _COSET_UNROLL_MAX) and dynamic-fori coset-scan paths
+    of the device state_info must agree bit-for-bit — the dynamic path is
+    what large 2-D groups (square_6x6: J=48) compile in reasonable time."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_matvec_tpu.ops import kernels as K
+
+    op = build_heisenberg(
+        12, 6, 1, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 0),
+                   ([11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1, 0], 0)])
+    op.basis.build()
+    t = K.device_tables(op)
+    J = t.group.elem.shape[0]
+    assert J > 1, "need a multi-coset group for this test"
+    states = jnp.asarray(
+        rng.integers(0, 1 << 12, 4096, dtype=np.uint64) | np.uint64(0))
+
+    rep_u, char_u, norm_u = jax.jit(K.state_info)(t.group, states)
+    monkeypatch.setattr(K, "_COSET_UNROLL_MAX", 0)   # force the fori path
+    rep_d, char_d, norm_d = jax.jit(
+        lambda g, s: K.state_info(g, s))(t.group, states)
+    np.testing.assert_array_equal(np.asarray(rep_u), np.asarray(rep_d))
+    np.testing.assert_array_equal(np.asarray(char_u), np.asarray(char_d))
+    np.testing.assert_array_equal(np.asarray(norm_u), np.asarray(norm_d))
