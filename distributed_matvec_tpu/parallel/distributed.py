@@ -63,7 +63,7 @@ from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
 from .engine import (SENTINEL_STATE, check_complex_backend, choose_ell_split,
-                     use_pair_complex)
+                     unroll_terms_ok, use_pair_complex)
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
 
@@ -388,8 +388,16 @@ class DistributedEngine:
                 return (c[:, None] if batched else c) * g
 
             def terms(y, gidx, coeff, width):
-                for t in range(width):
-                    y = y + contrib(coeff[t], gx(gidx[t]))
+                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
+                if unroll_terms_ok(width, gidx.shape[1], vw):
+                    for t in range(width):
+                        y = y + contrib(coeff[t], gx(gidx[t]))
+                else:
+                    def step(y, args):
+                        i, c = args
+                        return y + contrib(c, gx(i)), None
+                    y, _ = jax.lax.scan(step, y,
+                                        (gidx[:width], coeff[:width]))
                 return y
 
             d = diag.reshape(diag.shape + (1,) * (x.ndim - 1)).astype(dtype)

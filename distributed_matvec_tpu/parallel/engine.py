@@ -139,6 +139,20 @@ def check_complex_backend(effective_is_real: bool,
     )
 
 
+def unroll_terms_ok(width: int, rows: int, vec_width: int = 1) -> bool:
+    """Whether the per-term gather loop should be Python-unrolled.
+
+    Unrolling lets XLA schedule ALL term gathers concurrently — fastest, but
+    peak scratch is ≈ width·rows·vec_width·20 B of live gather outputs
+    (observed: a T0=40, N=15.9M table ran the matvec program to 11.9 GB and
+    OOM'd 16 GB HBM).  ``vec_width`` is the product of x's trailing axes —
+    batch columns and the (re, im) pair axis scale every gather output.
+    Beyond ~2 GB of estimated scratch, ``lax.scan`` serializes the terms:
+    same math, one term's scratch at a time.
+    """
+    return width <= 64 and width * rows * vec_width * 20 <= 2_000_000_000
+
+
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):
     pad = n_pad - reps.size
     alphas = np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
@@ -655,7 +669,7 @@ class LocalEngine:
         T0 = self._ell_T0
         W = self._c_W
         has_tail = self._c_tail is not None
-        use_sg = self._c_use_sg   # decided at build (only one table kept)
+        use_sg = self._c_use_sg   # decided at build (norm-table layout)
 
         from ..ops.split_gather import join_parts, split_parts
 
@@ -689,7 +703,8 @@ class LocalEngine:
                     w = s * ng
                     return acc + (w[:, None] if batched else w) * xg
 
-                if width <= 64:
+                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
+                if unroll_terms_ok(width, idxt.shape[1], vw):
                     for t in range(width):
                         acc = body(acc, idxt[t])
                 else:
@@ -742,7 +757,8 @@ class LocalEngine:
                 return (c[:, None] if batched else c) * g
 
             def terms(y, idx, coeff, width, sl=None):
-                if width <= 64:
+                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
+                if unroll_terms_ok(width, idx.shape[1], vw):
                     # Unrolled per-term gathers — contiguous coeff rows.
                     for t in range(width):
                         acc = contrib(coeff[t], gx(idx[t]))
