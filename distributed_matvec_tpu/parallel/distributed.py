@@ -388,8 +388,7 @@ class DistributedEngine:
                 return (c[:, None] if batched else c) * g
 
             def terms(y, gidx, coeff, width):
-                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
-                if unroll_terms_ok(width, gidx.shape[1], vw):
+                if unroll_terms_ok(width, gidx.shape[1], x.shape):
                     for t in range(width):
                         y = y + contrib(coeff[t], gx(gidx[t]))
                 else:

@@ -139,17 +139,19 @@ def check_complex_backend(effective_is_real: bool,
     )
 
 
-def unroll_terms_ok(width: int, rows: int, vec_width: int = 1) -> bool:
+def unroll_terms_ok(width: int, rows: int, x_shape=()) -> bool:
     """Whether the per-term gather loop should be Python-unrolled.
 
     Unrolling lets XLA schedule ALL term gathers concurrently — fastest, but
     peak scratch is ≈ width·rows·vec_width·20 B of live gather outputs
     (observed: a T0=40, N=15.9M table ran the matvec program to 11.9 GB and
-    OOM'd 16 GB HBM).  ``vec_width`` is the product of x's trailing axes —
-    batch columns and the (re, im) pair axis scale every gather output.
-    Beyond ~2 GB of estimated scratch, ``lax.scan`` serializes the terms:
-    same math, one term's scratch at a time.
+    OOM'd 16 GB HBM).  ``vec_width``, derived from ``x_shape``'s trailing
+    axes, covers batch columns and the (re, im) pair axis — both scale
+    every gather output.  Beyond ~2 GB of estimated scratch, ``lax.scan``
+    serializes the terms: same math, one term's scratch at a time.
     """
+    vec_width = int(np.prod(x_shape[1:], dtype=np.int64)) if len(x_shape) > 1 \
+        else 1
     return width <= 64 and width * rows * vec_width * 20 <= 2_000_000_000
 
 
@@ -703,8 +705,7 @@ class LocalEngine:
                     w = s * ng
                     return acc + (w[:, None] if batched else w) * xg
 
-                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
-                if unroll_terms_ok(width, idxt.shape[1], vw):
+                if unroll_terms_ok(width, idxt.shape[1], x.shape):
                     for t in range(width):
                         acc = body(acc, idxt[t])
                 else:
@@ -757,8 +758,7 @@ class LocalEngine:
                 return (c[:, None] if batched else c) * g
 
             def terms(y, idx, coeff, width, sl=None):
-                vw = int(np.prod(x.shape[1:], dtype=np.int64)) or 1
-                if unroll_terms_ok(width, idx.shape[1], vw):
+                if unroll_terms_ok(width, idx.shape[1], x.shape):
                     # Unrolled per-term gathers — contiguous coeff rows.
                     for t in range(width):
                         acc = contrib(coeff[t], gx(idx[t]))

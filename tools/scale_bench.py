@@ -72,7 +72,10 @@ def main():
     # that, "compact" (4 B/entry sign-tagged indices, isotropic sectors
     # only) stretches ~3× further; fused is the unbounded fallback.
     est_gb = n * T * 12 * 0.65 / 1e9
-    mode = args.mode or ("ell" if est_gb < 10.0 else "compact")
+    # standard packed ELL must leave headroom for matvec temporaries —
+    # an 8.5 GB table built fine but the apply ResourceExhausted'd at
+    # runtime on the 16 GB chip; beyond ~6 GB prefer compact (4 B/entry)
+    mode = args.mode or ("ell" if est_gb < 6.0 else "compact")
     log("engine_select", num_terms=T, est_packed_ell_gb=round(est_gb, 2),
         mode=mode)
 
