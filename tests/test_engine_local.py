@@ -186,6 +186,20 @@ def test_compact_mode_matches_dense(rng):
         update_config(split_gather=prev)
 
 
+def test_compact_mode_xxz_qualifies(rng):
+    """Anisotropy (Δ) only rescales the DIAGONAL, so the XXZ chain keeps a
+    single off-diagonal magnitude and qualifies for compact mode."""
+    from distributed_matvec_tpu.models.lattices import xxz_chain
+
+    op = xxz_chain(10, delta=0.37)
+    op.basis.build()
+    eng = LocalEngine(op, mode="compact")
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    np.testing.assert_allclose(np.asarray(eng.matvec(x)), op.matvec_host(x),
+                               atol=1e-13, rtol=1e-12)
+
+
 def test_compact_mode_refusals():
     """compact mode must refuse anisotropic couplings (several off-diagonal
     magnitudes) and complex-character sectors."""
