@@ -36,6 +36,8 @@ __all__ = [
     "load_golden",
     "save_observables",
     "make_or_restore_representatives",
+    "save_engine_structure",
+    "load_engine_structure",
 ]
 
 
@@ -48,6 +50,60 @@ def _h5py():
             "h5py is required for HDF5 I/O; it is unavailable in this "
             "environment"
         ) from e
+
+
+def save_engine_structure(path: str, fingerprint: str, mode: str,
+                          payload: dict) -> None:
+    """Checkpoint a precomputed engine structure under /engine_structure.
+
+    Extends the reference's representative checkpoint (`makeBasisStates`,
+    Diagonalize.chpl:227-246) one level up: the ELL/compact structure build
+    costs minutes at scale (square_6x6: 6.5 min on-device) but is a pure
+    function of (basis, operator, mode) — captured in ``fingerprint`` — so
+    a rerun can restore it in I/O time.  Scalars go to attrs, arrays to
+    datasets; None values are skipped.
+    """
+    h5py = _h5py()
+    # "w" truncates: the structure lives in its own (sidecar) file, so a
+    # rewrite reclaims space (h5py `del` would leave dead extents behind).
+    with h5py.File(path, "w") as f:
+        g = f.create_group("engine_structure")
+        g.attrs["mode"] = mode
+        for k, v in payload.items():
+            if v is None:
+                continue
+            if np.isscalar(v):
+                g.attrs[k] = v
+            else:
+                g.create_dataset(k, data=np.asarray(v))
+        # fingerprint LAST: a partially written file (killed mid-save) then
+        # fails the fingerprint check instead of restoring garbage
+        g.attrs["fingerprint"] = fingerprint
+
+
+def load_engine_structure(path: str, fingerprint: str) -> Optional[dict]:
+    """Restore a structure checkpoint; None unless the fingerprint matches
+    (a stale checkpoint for a different basis/operator/mode is ignored, not
+    an error)."""
+    import os
+
+    if not path or not os.path.exists(path):
+        return None
+    h5py = _h5py()
+    try:
+        with h5py.File(path, "r") as f:
+            if "engine_structure" not in f:
+                return None
+            g = f["engine_structure"]
+            if str(g.attrs.get("fingerprint", "")) != fingerprint:
+                return None
+            out = {k: g.attrs[k] for k in g.attrs}
+            for k in g:
+                out[k] = g[k][...]
+            return out
+    except OSError:
+        # truncated/corrupt checkpoint: rebuild rather than crash
+        return None
 
 
 def save_basis(path: str, representatives: np.ndarray,

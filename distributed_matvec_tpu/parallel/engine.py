@@ -175,7 +175,8 @@ class LocalEngine:
     """
 
     def __init__(self, operator: Operator, batch_size: Optional[int] = None,
-                 mode: Optional[str] = None):
+                 mode: Optional[str] = None,
+                 structure_cache: Optional[str] = None):
         basis = operator.basis
         if not basis.is_built:
             basis.build()
@@ -233,19 +234,115 @@ class LocalEngine:
             # [N_pad] f64, pad rows junk→masked
 
         if mode == "ell":
-            with self.timer.scope("build_structure"):
-                self._build_ell()
+            if not self._try_load_structure(structure_cache):
+                with self.timer.scope("build_structure"):
+                    self._build_ell()
+                self._save_structure(structure_cache)
             self._matvec = self._make_ell_matvec()
             self._checked = True                  # validated at build time
         elif mode == "compact":
-            with self.timer.scope("build_structure"):
-                self._build_compact()
+            if not self._try_load_structure(structure_cache):
+                with self.timer.scope("build_structure"):
+                    self._build_compact()
+                self._save_structure(structure_cache)
             self._matvec = self._make_compact_matvec()
             self._checked = True                  # validated at build time
         else:
             self._matvec = self._make_fused_matvec()
             self._checked = False
         self.timer.report()  # tree print, gated by display_timings
+
+    # -- structure checkpoint (ell/compact) ---------------------------------
+
+    @staticmethod
+    def _structure_sidecar(path: str) -> str:
+        """The structure checkpoint lives in its own file next to ``path``
+        (representatives etc.), so a rewrite truncates instead of growing."""
+        return path + ".structure.h5"
+
+    def _structure_fingerprint(self) -> str:
+        """Identity of the precomputed structure: basis (including the
+        *actual* representatives/norms, which may have been restored rather
+        than enumerated), operator term tables, mode, dtype form, padding.
+        Memoized — hashing ~GBs of representatives twice per construction
+        (load attempt + save) would cost seconds at scale."""
+        if getattr(self, "_fp_cache", None) is not None:
+            return self._fp_cache
+        import hashlib
+        import json as _json
+
+        h = hashlib.sha256()
+        basis = self.operator.basis
+        h.update(_json.dumps(basis._json_dict(), sort_keys=True,
+                             default=str).encode())
+        h.update(np.ascontiguousarray(basis.representatives).tobytes())
+        h.update(np.ascontiguousarray(basis.norms).tobytes())
+        dt, ot = self.operator.diag_table, self.operator.off_diag_table
+        for a in (dt.v, dt.s, dt.m, dt.r, ot.x, ot.v, ot.s, ot.m, ot.r):
+            h.update(np.ascontiguousarray(a).tobytes())
+        h.update(f"{self.mode}|{self.pair}|{self.real}|{self.batch_size}"
+                 f"|{self.n_states}|{self.n_padded}|v1".encode())
+        self._fp_cache = h.hexdigest()
+        return self._fp_cache
+
+    def _try_load_structure(self, path: Optional[str]) -> bool:
+        if not path:
+            return False
+        import os
+
+        from ..io.hdf5 import load_engine_structure
+
+        sidecar = self._structure_sidecar(path)
+        if not os.path.exists(sidecar):
+            return False     # don't hash GBs when there is nothing to load
+        data = load_engine_structure(sidecar, self._structure_fingerprint())
+        if data is None:
+            return False
+        self._ell_T0 = int(data["T0"])
+        if self.mode == "ell":
+            self._ell_idx = jnp.asarray(data["idx"])
+            self._ell_coeff = jnp.asarray(data["coeff"])
+            self._ell_tail = None
+            if "tail_rows" in data:
+                self._ell_tail = (jnp.asarray(data["tail_rows"]),
+                                  jnp.asarray(data["tail_idx"]),
+                                  jnp.asarray(data["tail_coeff"]))
+        else:
+            self._c_W = float(data["W"])
+            self._c_idx = jnp.asarray(data["idx"])
+            self._c_tail = None
+            if "tail_rows" in data:
+                self._c_tail = (jnp.asarray(data["tail_rows"]),
+                                jnp.asarray(data["tail_idx"]))
+            self._finish_compact_aux()
+        log_debug(f"engine structure restored from {path}")
+        return True
+
+    def _save_structure(self, path: Optional[str]) -> None:
+        if not path:
+            return
+        from ..io.hdf5 import save_engine_structure
+
+        if self.mode == "ell":
+            payload = {"T0": self._ell_T0,
+                       "idx": np.asarray(self._ell_idx),
+                       "coeff": np.asarray(self._ell_coeff)}
+            if self._ell_tail is not None:
+                rows, idx_t, cf_t = self._ell_tail
+                payload.update(tail_rows=np.asarray(rows),
+                               tail_idx=np.asarray(idx_t),
+                               tail_coeff=np.asarray(cf_t))
+        else:
+            payload = {"T0": self._ell_T0, "W": self._c_W,
+                       "idx": np.asarray(self._c_idx)}
+            if self._c_tail is not None:
+                rows, idx_t = self._c_tail
+                payload.update(tail_rows=np.asarray(rows),
+                               tail_idx=np.asarray(idx_t))
+        sidecar = self._structure_sidecar(path)
+        save_engine_structure(sidecar, self._structure_fingerprint(),
+                              self.mode, payload)
+        log_debug(f"engine structure checkpointed to {sidecar}")
 
     # -- structure build (ell mode) -----------------------------------------
 
@@ -651,6 +748,11 @@ class LocalEngine:
             )
         self._c_idx = out_idx
         self._c_tail = None if S == 0 else (t_rows[:S], t_idx[:, :S])
+        self._finish_compact_aux()
+
+    def _finish_compact_aux(self) -> None:
+        """Derived compact-mode arrays (cheap; recomputed on cache restore)."""
+        n, n_pad = self.n_states, self.n_padded
         inv_n = np.ones(n_pad)
         nrm_host = np.asarray(self.operator.basis.norms)
         inv_n[:n] = 1.0 / nrm_host
@@ -661,7 +763,8 @@ class LocalEngine:
         from ..ops.split_gather import split_parts
         self._c_use_sg = split_gather_enabled()
         if self._c_use_sg:
-            self._c_n_parts = jax.jit(split_parts)(norms_dev)   # [n, 3] f32
+            self._c_n_parts = jax.jit(split_parts)(
+                jnp.asarray(nrm_host))                          # [n, 3] f32
         else:
             self._c_n_parts = jnp.zeros((0, 3), jnp.float32)
 

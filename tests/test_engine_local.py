@@ -222,6 +222,49 @@ def test_compact_mode_refusals():
         LocalEngine(op2, mode="compact")
 
 
+def test_structure_cache_roundtrip(tmp_path, rng):
+    """ELL/compact structure checkpoints restore bit-identically and are
+    keyed by a fingerprint: a different operator must NOT reuse them."""
+    path = str(tmp_path / "cache.h5")
+    op = build_heisenberg(12, 6, 1,
+                          [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 0),
+                           ([11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1, 0], 0)])
+    op.basis.build()
+    N = op.basis.number_states
+    x = rng.random(N) - 0.5
+
+    for mode in ("ell", "compact"):
+        eng1 = LocalEngine(op, batch_size=61, mode=mode,
+                           structure_cache=path)
+        y1 = np.asarray(eng1.matvec(x))
+        # second construction must restore, not rebuild
+        import distributed_matvec_tpu.parallel.engine as E
+        builder = "_build_ell" if mode == "ell" else "_build_compact"
+        orig = getattr(E.LocalEngine, builder)
+        def _boom(self):
+            raise AssertionError("structure cache was not used")
+        setattr(E.LocalEngine, builder, _boom)
+        try:
+            eng2 = LocalEngine(op, batch_size=61, mode=mode,
+                               structure_cache=path)
+        finally:
+            setattr(E.LocalEngine, builder, orig)
+        np.testing.assert_array_equal(y1, np.asarray(eng2.matvec(x)))
+
+    # a different operator (scaled coupling) must invalidate the cache
+    op2 = 2.0 * build_heisenberg(
+        12, 6, 1, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 0),
+                   ([11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1, 0], 0)])
+    op2.basis.build()
+    eng3 = LocalEngine(op2, batch_size=61, mode="ell",
+                       structure_cache=path)
+    np.testing.assert_allclose(np.asarray(eng3.matvec(x)),
+                               2.0 * np.asarray(
+                                   LocalEngine(op, batch_size=61,
+                                               mode="ell").matvec(x)),
+                               atol=1e-13)
+
+
 def test_ell_split_cost_model_properties():
     """choose_ell_split: scatter-heavy layouts are rejected, truncation-only
     wins are kept, and degenerate histograms fall back to the full table."""

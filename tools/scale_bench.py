@@ -81,7 +81,9 @@ def main():
 
     t0 = time.time()
     try:
-        eng = LocalEngine(op, mode=mode)
+        # the structure is checkpointed alongside the representatives, so a
+        # rerun restores it in I/O time instead of minutes of build
+        eng = LocalEngine(op, mode=mode, structure_cache=args.out)
     except (ValueError, RuntimeError) as e:
         # compact refuses up front (ValueError) or after full build-time
         # ratio validation (RuntimeError) — fall back to fused either way
