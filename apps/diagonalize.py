@@ -63,8 +63,8 @@ def main(argv=None):
     ap.add_argument("--mode", choices=("ell", "compact", "fused"),
                     default="ell",
                     help="engine mode: precomputed structure (ell), "
-                         "4 B/entry for isotropic sectors (compact, "
-                         "single-device), or recompute-on-the-fly (fused)")
+                         "4 B/entry for isotropic real sectors (compact), "
+                         "or recompute-on-the-fly (fused)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--no-eigenvectors", action="store_true",
@@ -74,12 +74,6 @@ def main(argv=None):
     ap.add_argument("--timings", action="store_true",
                     help="print phase timings (kDisplayTimings)")
     args = ap.parse_args(argv)
-    if args.mode == "compact" and args.devices and args.devices > 1:
-        # fail fast — the enumeration ahead of engine construction can take
-        # tens of minutes at scale
-        print("--mode compact is single-device only; use ell or fused "
-              "with --devices", file=sys.stderr)
-        return 2
 
     from distributed_matvec_tpu.io import (
         make_or_restore_representatives, save_eigen)

@@ -10,7 +10,7 @@ is sharded over a 1-D ``jax.sharding.Mesh`` (state σ lives on shard
 ``hash64(σ) % D``, exactly ``localeIdxOf``, StatesEnumeration.chpl:129-136)
 and the exchange is a single XLA ``all_to_all`` over ICI inside ``shard_map``.
 
-Two modes, mirroring :class:`~.engine.LocalEngine`:
+Three modes, mirroring :class:`~.engine.LocalEngine`:
 
 * ``"ell"`` (default) — **static routing plan**.  Because the sparsity
   structure is fixed per (operator, basis), the cross-shard communication
@@ -26,6 +26,12 @@ Two modes, mirroring :class:`~.engine.LocalEngine`:
   — no u64 hashing, no sort, no searchsorted, no scatter at matvec time.
   This replaces the reference's *dynamic* producer/consumer routing with a
   compile-time communication plan, the way XLA itself handles sharded matmuls.
+
+* ``"compact"`` — the ELL routing plan with 4 B/entry sign-tagged indices
+  for isotropic real sectors (coefficients derived as ``W·s·n(j)/n(i)`` at
+  matvec time; remote norms are STATIC and exchanged once at plan time, so
+  the per-apply ``all_to_all`` still carries only x values) — per-shard
+  capacity ~3× over ELL.
 
 * ``"fused"`` — dynamic bucketing for bases whose ELL tables exceed HBM: per
   row chunk, generate amplitudes (scatter form), sort by owner, compact into
@@ -102,19 +108,8 @@ class DistributedEngine:
         if not basis.is_built:
             basis.build()
         cfg = get_config()
-        if mode is None:
-            mode = cfg.matvec_mode
-            if mode == "compact":
-                # the global knob may be tuned for LocalEngine runs; fall
-                # back rather than fail a consumer that never supported it
-                log_debug("compact mode is single-device only; "
-                          "DistributedEngine falls back to 'ell'")
-                mode = "ell"
-        if mode == "compact":
-            raise ValueError(
-                "compact mode is single-device only (LocalEngine); use "
-                "'ell' or 'fused' for DistributedEngine")
-        if mode not in ("ell", "fused"):
+        mode = mode or cfg.matvec_mode
+        if mode not in ("ell", "compact", "fused"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:
             raise ValueError("the engine requires a Hermitian operator")
@@ -167,6 +162,11 @@ class DistributedEngine:
                 self._build_plan(alphas, nrm)
             self._matvec = self._make_ell_matvec()
             self._checked = True
+        elif mode == "compact":
+            with self.timer.scope("build_plan"):
+                self._build_compact_plan(alphas, nrm)
+            self._matvec = self._make_compact_matvec()
+            self._checked = True
         else:
             # Per-shard bucketed lookup over each shard's REAL prefix
             # (SENTINEL pads sort last, so real entries are alphas[d][:count]
@@ -202,12 +202,14 @@ class DistributedEngine:
     # ELL mode: static routing plan
     # ------------------------------------------------------------------
 
-    def _build_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray) -> None:
+    def _host_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray):
         """Compute per-shard neighbor structure + the cross-shard query plan.
 
         Replaces the reference's per-matvec radix partition + buffer routing
         (DistributedMatrixVector.chpl:265-311, :559-735) with a one-time
-        host-coordinated exchange of *static* query lists.
+        host-coordinated exchange of *static* query lists.  Returns
+        ``(g_idx, coeffs, owners, idxs, queries, qin)`` — shared by the ELL
+        and compact uploads.
         """
         D, M, T = self.n_devices, self.shard_size, self.num_terms
         from ..enumeration.host import hash64 as hash64_host
@@ -296,7 +298,13 @@ class DistributedEngine:
                     continue
                 qq = queries[q][d]
                 qin[d, q, : qq.size] = qq
+        self._qin = jax.device_put(jnp.asarray(qin),
+                                   shard_spec(self.mesh, 3))
+        return g_idx, coeffs, owners, idxs, queries, qin
 
+    def _build_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray) -> None:
+        """ELL upload of the host plan: packed f64/c128 coefficient tables."""
+        g_idx, coeffs, _, _, _, qin = self._host_plan(alphas_h, norms_h)
         g_idx, coeffs, tail = self._split_tables(g_idx, coeffs)
         sh3 = shard_spec(self.mesh, 3)
         # Transposed [T0, M(, 2)] per shard (see LocalEngine layout note);
@@ -317,7 +325,6 @@ class DistributedEngine:
             self._ell_tail = tuple(
                 jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
                 for a in (rows_t, idx_t, cf_t))
-        self._qin = jax.device_put(jnp.asarray(qin), sh3)
 
     def _split_tables(self, g_idx: np.ndarray, coeffs: np.ndarray):
         """Two-level split of the [D, M, T] tables (host-side analog of
@@ -359,6 +366,188 @@ class DistributedEngine:
             idx_t[d, :, : rd.size] = g_p[d, rd, T0:Tmax].T
             cf_t[d, :, : rd.size] = c_p[d, rd, T0:Tmax].T
         return g_p[:, :, :T0], c_p[:, :, :T0], (rows, idx_t, cf_t)
+
+    def _build_compact_plan(self, alphas_h: np.ndarray,
+                            norms_h: np.ndarray) -> None:
+        """Compact upload of the host plan: sign-tagged 4 B/entry indices.
+
+        Mirrors :meth:`LocalEngine._build_compact` across shards: for real
+        sectors with one off-diagonal magnitude W, the coefficient
+        ``W·s·n(j)/n(i)`` is derived at matvec time, with n(j) looked up in
+        a STATIC concat(n_local, n_remote) table — remote norms never change,
+        so only x values ride the per-apply ``all_to_all`` (same exchange as
+        ELL mode).  Validated entry-by-entry on the host plan.
+        """
+        if not self.real or self.pair:
+            raise ValueError(
+                "compact mode requires a real sector (use mode='ell' for "
+                "complex-character momentum sectors)")
+        sample = self.operator.basis.representatives[:4096]
+        _, amps = self.operator.apply_off_diag(sample)
+        vals = np.unique(np.abs(amps[amps != 0]))
+        if vals.size != 1:
+            raise ValueError(
+                f"compact mode needs a single off-diagonal magnitude, "
+                f"found {vals[:5]}; use mode='ell'")
+        W = float(vals[0])
+        self._c_W = W
+
+        g_idx, coeffs, owners, idxs, queries, qin = self._host_plan(
+            alphas_h, norms_h)
+        D, M = self.n_devices, self.shard_size
+        C = self.query_capacity
+
+        # validate |coeff| == W·n(j)/n(i) on the host plan
+        n_b = np.ones_like(coeffs, dtype=np.float64)
+        for p in range(D):
+            sel = owners == p
+            n_b[sel] = norms_h[p][idxs[sel]]
+        live = coeffs != 0
+        ratio = np.abs(coeffs) * norms_h[:, :, None] / n_b
+        bad = int((live & (np.abs(ratio - W) > 1e-9 * W)).sum())
+        if bad:
+            raise RuntimeError(
+                f"{bad} matrix elements violate the ±W·n(j)/n(i) form "
+                f"(W={W}); the operator does not qualify for compact mode "
+                "— use mode='ell'"
+            )
+
+        # pack with the shared splitter, then convert to sign tags
+        g_p, c_p, tail = self._split_tables(g_idx, coeffs)
+        tags = np.where(c_p != 0,
+                        np.sign(c_p).astype(np.int32)
+                        * (g_p.astype(np.int32) + 1), 0)
+        sh3 = shard_spec(self.mesh, 3)
+        self._c_idx = jax.device_put(
+            jnp.asarray(np.swapaxes(tags, 1, 2)), sh3)      # [D, T0, M]
+        if tail is None:
+            self._c_tail = None
+        else:
+            rows_t, idx_t, cf_t = tail
+            tag_t = np.where(cf_t != 0,
+                             np.sign(cf_t).astype(np.int32)
+                             * (idx_t.astype(np.int32) + 1), 0)
+            self._c_tail = tuple(
+                jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
+                for a in (rows_t, tag_t))
+
+        # static norm table over the gather space: concat(x_local, R) for
+        # D > 1, x_local alone on a single shard (no exchange happens)
+        n_all = np.ones((D, M + D * C if D > 1 else M))
+        n_all[:, :M] = norms_h
+        for d in range(D):
+            for p in range(D):
+                q = queries[d][p]
+                if q is None or q.size == 0:
+                    continue
+                n_all[d, M + p * C: M + p * C + q.size] = norms_h[p][q]
+        inv_n = 1.0 / norms_h                                # pads are 1.0
+        self._c_inv_n = jax.device_put(jnp.asarray(inv_n),
+                                       shard_spec(self.mesh, 2))
+        from ..ops.split_gather import split_parts
+        self._c_use_sg = split_gather_enabled()
+        if self._c_use_sg:
+            self._c_n_parts = jax.device_put(
+                jax.jit(split_parts)(jnp.asarray(n_all)),
+                shard_spec(self.mesh, 3))                    # [D, M+DC, 3]
+            self._c_norms = jax.device_put(jnp.zeros((D, 0)),
+                                           shard_spec(self.mesh, 2))
+        else:
+            self._c_n_parts = jax.device_put(
+                jnp.zeros((D, 0, 3), jnp.float32), shard_spec(self.mesh, 3))
+            self._c_norms = jax.device_put(jnp.asarray(n_all),
+                                           shard_spec(self.mesh, 2))
+
+    def _make_compact_matvec(self):
+        D, C = self.n_devices, self.query_capacity
+        T0 = self._ell_T0
+        W = self._c_W
+        has_tail = self._c_tail is not None
+        use_sg = self._c_use_sg
+
+        from ..ops.split_gather import join_parts, split_parts
+
+        def shard_body(x, qin, tags, diag, inv_n, n_parts, norms_all, tail):
+            x, qin, tags, diag, inv_n = (
+                a[0] for a in (x, qin, tags, diag, inv_n))
+            n_parts, norms_all = n_parts[0], norms_all[0]
+            batched = x.ndim == 2
+            if D > 1:
+                S = x[qin]
+                R = jax.lax.all_to_all(S, SHARD_AXIS, 0, 0, tiled=True)
+                xx = jnp.concatenate(
+                    [x, R.reshape((D * C,) + x.shape[1:])], axis=0)
+            else:
+                xx = x
+
+            if use_sg:
+                xs = split_parts(xx).reshape(xx.shape[0], -1)
+                kx = xs.shape[1]
+                src = jnp.concatenate([xs, n_parts], axis=1)
+
+                def gather_nx(i):
+                    g = src[i]
+                    xg = join_parts(
+                        g[..., :kx].reshape(i.shape + x.shape[1:] + (3,)),
+                        jnp.float64)
+                    ng = join_parts(g[..., kx:], jnp.float64)
+                    return xg, ng
+            else:
+                def gather_nx(i):
+                    return xx[i], norms_all[i]
+
+            def terms(acc, tags, width):
+                def body(acc, v):
+                    i = jnp.maximum(jnp.abs(v) - 1, 0)
+                    s = jnp.sign(v).astype(jnp.float64)
+                    xg, ng = gather_nx(i)
+                    w = s * ng
+                    return acc + (w[:, None] if batched else w) * xg
+
+                if unroll_terms_ok(width, tags.shape[1], x.shape):
+                    for t in range(width):
+                        acc = body(acc, tags[t])
+                else:
+                    acc, _ = jax.lax.scan(
+                        lambda a, v: (body(a, v), None), acc, tags[:width])
+                return acc
+
+            acc = terms(jnp.zeros(x.shape, jnp.float64), tags, T0)
+            d = diag.reshape(diag.shape + (1,) * (x.ndim - 1))
+            sc = (W * inv_n).reshape(inv_n.shape + (1,) * (x.ndim - 1))
+            y = d * x + sc * acc
+            if has_tail:
+                rows, tag_t = (a[0] for a in tail)
+                acc_t = terms(jnp.zeros(rows.shape + x.shape[1:]),
+                              tag_t, tag_t.shape[0])
+                sct = W * inv_n[rows]
+                y = y.at[rows].add(
+                    (sct[:, None] if batched else sct) * acc_t, mode="drop")
+            return y[None]
+
+        mesh = self.mesh
+
+        def apply_fn(x, operands):
+            qin, tags, diag, inv_n, n_parts, norms_all, tail = operands
+            tail_specs = tuple(_pspec(a.ndim) for a in tail) if has_tail \
+                else P()
+            f = jax.shard_map(
+                shard_body, mesh=mesh,
+                in_specs=(_pspec(x.ndim), _pspec(qin.ndim),
+                          _pspec(tags.ndim), _pspec(diag.ndim),
+                          _pspec(inv_n.ndim), _pspec(n_parts.ndim),
+                          _pspec(norms_all.ndim), tail_specs),
+                out_specs=_pspec(x.ndim),
+            )
+            y = f(x.astype(jnp.float64), qin, tags, diag, inv_n, n_parts,
+                  norms_all, tail)
+            return y, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64)
+
+        self._apply_fn = apply_fn
+        self._operands = (self._qin, self._c_idx, self._diag, self._c_inv_n,
+                          self._c_n_parts, self._c_norms, self._c_tail)
+        _mv = jax.jit(apply_fn)
+        return lambda x: _mv(x, self._operands)
 
     def _make_ell_matvec(self):
         D, C = self.n_devices, self.query_capacity

@@ -85,7 +85,7 @@ def test_distributed_matches_host(n, hw, inv, syms, ndev, mode, rng):
 
 
 @needs_8
-@pytest.mark.parametrize("mode", ["ell", "fused"])
+@pytest.mark.parametrize("mode", ["ell", "compact", "fused"])
 def test_distributed_matches_local_engine(mode, rng):
     from distributed_matvec_tpu.parallel.engine import LocalEngine
 
@@ -100,7 +100,7 @@ def test_distributed_matches_local_engine(mode, rng):
 
 
 @needs_8
-@pytest.mark.parametrize("mode", ["ell"])
+@pytest.mark.parametrize("mode", ["ell", "compact"])
 def test_distributed_batch(mode, rng):
     op = build_heisenberg(10, 5, None, ())
     op.basis.build()
@@ -158,32 +158,68 @@ def test_graft_entry_dryrun():
         pytest.skip("needs 8 devices")
 
 
-def test_distributed_ell_split_tail_exercised(rng):
+@pytest.mark.parametrize("mode", ["ell", "compact"])
+def test_distributed_ell_split_tail_exercised(mode, rng):
     """The two-level split must trigger on the sharded plan too (global T0,
     per-shard padded tail) and stay exact vs the host path."""
     op = build_heisenberg(16, 8, None)
     op.basis.build()
-    eng = DistributedEngine(op, n_devices=4)
+    eng = DistributedEngine(op, n_devices=4, mode=mode)
     assert eng._ell_T0 < eng.num_terms, "split did not trigger"
-    assert eng._ell_tail is not None, "tail path not exercised"
+    tail = eng._ell_tail if mode == "ell" else eng._c_tail
+    assert tail is not None, "tail path not exercised"
     n = op.basis.number_states
     x = rng.random(n) - 0.5
     np.testing.assert_allclose(eng.matvec_global(x), op.matvec_host(x),
                                atol=1e-13, rtol=1e-12)
 
 
-def test_split_gather_distributed_matches_plain(rng):
+@pytest.mark.parametrize("mode", ["ell", "compact"])
+def test_split_gather_distributed_matches_plain(mode, rng):
     from distributed_matvec_tpu.utils.config import update_config
 
     op = build_heisenberg(12, 6, None)
     op.basis.build()
     n = op.basis.number_states
     x = rng.random(n) - 0.5
+    X = rng.random((n, 2)) - 0.5
     update_config(split_gather="off")
-    y_ref = DistributedEngine(op, n_devices=4).matvec_global(x)
+    ref = DistributedEngine(op, n_devices=4, mode=mode)
+    y_ref = ref.matvec_global(x)
+    Y_ref = ref.from_hashed(ref.matvec(ref.to_hashed(X)))
     update_config(split_gather="on")
     try:
-        y = DistributedEngine(op, n_devices=4).matvec_global(x)
+        eng = DistributedEngine(op, n_devices=4, mode=mode)
+        y = eng.matvec_global(x)
+        Y = eng.from_hashed(eng.matvec(eng.to_hashed(X)))
     finally:
         update_config(split_gather="auto")
     np.testing.assert_allclose(y, y_ref, atol=1e-14, rtol=1e-14)
+    np.testing.assert_allclose(Y, Y_ref, atol=1e-14, rtol=1e-14)
+
+
+def test_distributed_compact_refusals():
+    """Distributed compact refuses complex sectors and anisotropic couplings
+    exactly like the local engine."""
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.utils.config import update_config
+
+    b = SpinBasis(8, 4)
+    op = heisenberg_from_edges(b, chain_edges(8)) \
+        + 0.44 * heisenberg_from_edges(b, [(i, (i + 2) % 8)
+                                           for i in range(8)])
+    b.build()
+    with pytest.raises(ValueError, match="single off-diagonal magnitude"):
+        DistributedEngine(op, n_devices=2, mode="compact")
+
+    b2 = SpinBasis(10, 5, None, [([1, 2, 3, 4, 5, 6, 7, 8, 9, 0], 1)])
+    op2 = heisenberg_from_edges(b2, chain_edges(10))
+    b2.build()
+    update_config(complex_pair="on")
+    try:
+        with pytest.raises(ValueError, match="real sector"):
+            DistributedEngine(op2, n_devices=2, mode="compact")
+    finally:
+        update_config(complex_pair="auto")
