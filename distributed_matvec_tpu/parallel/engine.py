@@ -233,15 +233,20 @@ class LocalEngine:
             self._diag = jax.jit(K.apply_diag)(self.tables.diag, self._alphas)
             # [N_pad] f64, pad rows junk→masked
 
+        #: True when the structure came from a ``structure_cache`` restore
+        #: rather than a fresh build (deterministic signal for callers/tests).
+        self.structure_restored = False
         if mode == "ell":
-            if not self._try_load_structure(structure_cache):
+            self.structure_restored = self._try_load_structure(structure_cache)
+            if not self.structure_restored:
                 with self.timer.scope("build_structure"):
                     self._build_ell()
                 self._save_structure(structure_cache)
             self._matvec = self._make_ell_matvec()
             self._checked = True                  # validated at build time
         elif mode == "compact":
-            if not self._try_load_structure(structure_cache):
+            self.structure_restored = self._try_load_structure(structure_cache)
+            if not self.structure_restored:
                 with self.timer.scope("build_structure"):
                     self._build_compact()
                 self._save_structure(structure_cache)

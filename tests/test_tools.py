@@ -1,0 +1,39 @@
+"""The ops tooling must not bit-rot: scale_bench end-to-end on a small
+config (CPU), including the representative checkpoint and the engine
+structure cache it wires up."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="true")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scale_bench.py"),
+         "--config", "heisenberg_chain_16.yaml",
+         "--out", str(tmp_path / "c16.h5"), "--solver-iters", "4", *args],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return [json.loads(line) for line in r.stdout.splitlines()
+            if line.startswith("{")]
+
+
+def test_scale_bench_end_to_end(tmp_path):
+    phases = _run(["--mode", "compact"], tmp_path)
+    by = {p["phase"]: p for p in phases}
+    assert by["enumerate"]["n_states"] == 12870
+    assert not by["enumerate"]["restored"]
+    assert by["engine_build"]["ell_gb"] >= 0
+    assert by["matvec"]["ms_per_apply"] > 0
+    assert by["lanczos"]["iters"] == 4
+    assert not by["engine_build"]["structure_restored"]
+    # second run restores the representatives AND the engine structure
+    phases2 = _run(["--mode", "compact"], tmp_path)
+    by2 = {p["phase"]: p for p in phases2}
+    assert by2["enumerate"]["restored"]
+    assert by2["engine_build"]["structure_restored"]
+    assert os.path.exists(str(tmp_path / "c16.h5") + ".structure.h5")

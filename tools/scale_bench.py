@@ -94,6 +94,7 @@ def main():
         eng = LocalEngine(op, mode=mode)
     log("engine_build", seconds=round(time.time() - t0, 1),
         ell_gb=round(eng.ell_nbytes / 1e9, 2),
+        structure_restored=getattr(eng, "structure_restored", False),
         backend=jax.default_backend())
 
     x = jnp.asarray(np.random.default_rng(42).standard_normal(n))
