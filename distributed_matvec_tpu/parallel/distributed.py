@@ -103,7 +103,8 @@ class DistributedEngine:
     def __init__(self, operator: Operator, mesh: Optional[Mesh] = None,
                  n_devices: Optional[int] = None,
                  batch_size: Optional[int] = None,
-                 mode: Optional[str] = None):
+                 mode: Optional[str] = None,
+                 structure_cache: Optional[str] = None):
         basis = operator.basis
         if not basis.is_built:
             basis.build()
@@ -157,14 +158,25 @@ class DistributedEngine:
         self.batch_size = _round_up(min(b, M), 8)
         self._checked = False
 
+        #: True when the plan came from a ``structure_cache`` restore rather
+        #: than a fresh host-coordinated build.
+        self.structure_restored = False
         if mode == "ell":
-            with self.timer.scope("build_plan"):
-                self._build_plan(alphas, nrm)
+            self.structure_restored = self._try_load_structure(structure_cache)
+            if not self.structure_restored:
+                with self.timer.scope("build_plan"):
+                    self._build_plan(alphas, nrm)
+                self._save_structure(structure_cache)
             self._matvec = self._make_ell_matvec()
             self._checked = True
         elif mode == "compact":
-            with self.timer.scope("build_plan"):
-                self._build_compact_plan(alphas, nrm)
+            self.structure_restored = self._try_load_structure(
+                structure_cache, norms_h=nrm)
+            if not self.structure_restored:
+                with self.timer.scope("build_plan"):
+                    self._build_compact_plan(alphas, nrm)
+                self._save_structure(structure_cache)
+                self._c_n_all = None   # only needed by the save just done
             self._matvec = self._make_compact_matvec()
             self._checked = True
         else:
@@ -441,6 +453,16 @@ class DistributedEngine:
                 if q is None or q.size == 0:
                     continue
                 n_all[d, M + p * C: M + p * C + q.size] = norms_h[p][q]
+        self._finish_compact_aux(n_all, norms_h)
+        self._c_n_all = n_all    # kept only until _save_structure runs
+
+    def _finish_compact_aux(self, n_all: np.ndarray,
+                            norms_h: Optional[np.ndarray] = None) -> None:
+        """Derived compact-mode device arrays (recomputed on cache restore)."""
+        D = self.n_devices
+        if norms_h is None:
+            norms_h = self.layout.to_hashed(self.operator.basis.norms,
+                                            fill=1.0)
         inv_n = 1.0 / norms_h                                # pads are 1.0
         self._c_inv_n = jax.device_put(jnp.asarray(inv_n),
                                        shard_spec(self.mesh, 2))
@@ -457,6 +479,96 @@ class DistributedEngine:
                 jnp.zeros((D, 0, 3), jnp.float32), shard_spec(self.mesh, 3))
             self._c_norms = jax.device_put(jnp.asarray(n_all),
                                            shard_spec(self.mesh, 2))
+
+    # -- plan checkpoint (ell/compact) ----------------------------------
+
+    def _structure_sidecar(self, path: str) -> str:
+        """Distinct from LocalEngine's sidecar (and per mesh size) so local
+        and distributed checkpoints for the same basis don't thrash."""
+        return f"{path}.dist{self.n_devices}.structure.h5"
+
+    def _structure_fingerprint(self) -> str:
+        if getattr(self, "_fp_cache", None) is not None:
+            return self._fp_cache
+        import hashlib
+
+        from .engine import hash_basis_operator
+
+        h = hashlib.sha256()
+        hash_basis_operator(h, self.operator)
+        h.update(f"dist|{self.mode}|{self.pair}|{self.real}"
+                 f"|{self.n_devices}|{self.shard_size}|v1".encode())
+        self._fp_cache = h.hexdigest()
+        return self._fp_cache
+
+    def _try_load_structure(self, path: Optional[str],
+                            norms_h: Optional[np.ndarray] = None) -> bool:
+        if not path:
+            return False
+        import os
+
+        from ..io.hdf5 import load_engine_structure
+
+        sidecar = self._structure_sidecar(path)
+        if not os.path.exists(sidecar):
+            return False
+        data = load_engine_structure(sidecar, self._structure_fingerprint())
+        if data is None:
+            return False
+        sh3 = shard_spec(self.mesh, 3)
+        self._ell_T0 = int(data["T0"])
+        self.query_capacity = int(data["C"])
+        self._qin = jax.device_put(jnp.asarray(data["qin"]), sh3)
+
+        def put(a):
+            return jax.device_put(jnp.asarray(a),
+                                  shard_spec(self.mesh, np.ndim(a)))
+
+        if self.mode == "ell":
+            self._ell_idx = put(data["idx"])
+            self._ell_coeff = put(data["coeff"])
+            self._ell_tail = None
+            if "tail_rows" in data:
+                self._ell_tail = (put(data["tail_rows"]),
+                                  put(data["tail_idx"]),
+                                  put(data["tail_coeff"]))
+        else:
+            self._c_W = float(data["W"])
+            self._c_idx = put(data["idx"])
+            self._c_tail = None
+            if "tail_rows" in data:
+                self._c_tail = (put(data["tail_rows"]),
+                                put(data["tail_idx"]))
+            self._finish_compact_aux(data["n_all"], norms_h)
+        log_debug(f"distributed plan restored from {sidecar}")
+        return True
+
+    def _save_structure(self, path: Optional[str]) -> None:
+        if not path:
+            return
+        from ..io.hdf5 import save_engine_structure
+
+        payload = {"T0": self._ell_T0, "C": self.query_capacity,
+                   "qin": np.asarray(self._qin)}
+        if self.mode == "ell":
+            payload.update(idx=np.asarray(self._ell_idx),
+                           coeff=np.asarray(self._ell_coeff))
+            if self._ell_tail is not None:
+                rows, idx_t, cf_t = self._ell_tail
+                payload.update(tail_rows=np.asarray(rows),
+                               tail_idx=np.asarray(idx_t),
+                               tail_coeff=np.asarray(cf_t))
+        else:
+            payload.update(W=self._c_W, idx=np.asarray(self._c_idx),
+                           n_all=self._c_n_all)
+            if self._c_tail is not None:
+                rows, tag_t = self._c_tail
+                payload.update(tail_rows=np.asarray(rows),
+                               tail_idx=np.asarray(tag_t))
+        sidecar = self._structure_sidecar(path)
+        save_engine_structure(sidecar, self._structure_fingerprint(),
+                              self.mode, payload)
+        log_debug(f"distributed plan checkpointed to {sidecar}")
 
     def _make_compact_matvec(self):
         D, C = self.n_devices, self.query_capacity

@@ -154,6 +154,23 @@ def unroll_terms_ok(width: int, rows: int, x_shape=()) -> bool:
     return width <= 64 and width * rows * vec_width * 20 <= 2_000_000_000
 
 
+def hash_basis_operator(h, operator) -> None:
+    """Feed everything that identifies a (basis, operator) pair into a hash:
+    the basis JSON, the ACTUAL representative/norm arrays (they may have been
+    restored rather than enumerated), and the nonbranching term tables.
+    Shared by both engines' structure fingerprints so they cannot drift."""
+    import json as _json
+
+    basis = operator.basis
+    h.update(_json.dumps(basis._json_dict(), sort_keys=True,
+                         default=str).encode())
+    h.update(np.ascontiguousarray(basis.representatives).tobytes())
+    h.update(np.ascontiguousarray(basis.norms).tobytes())
+    dt, ot = operator.diag_table, operator.off_diag_table
+    for a in (dt.v, dt.s, dt.m, dt.r, ot.x, ot.v, ot.s, ot.m, ot.r):
+        h.update(np.ascontiguousarray(a).tobytes())
+
+
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):
     pad = n_pad - reps.size
     alphas = np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
@@ -274,17 +291,9 @@ class LocalEngine:
         if getattr(self, "_fp_cache", None) is not None:
             return self._fp_cache
         import hashlib
-        import json as _json
 
         h = hashlib.sha256()
-        basis = self.operator.basis
-        h.update(_json.dumps(basis._json_dict(), sort_keys=True,
-                             default=str).encode())
-        h.update(np.ascontiguousarray(basis.representatives).tobytes())
-        h.update(np.ascontiguousarray(basis.norms).tobytes())
-        dt, ot = self.operator.diag_table, self.operator.off_diag_table
-        for a in (dt.v, dt.s, dt.m, dt.r, ot.x, ot.v, ot.s, ot.m, ot.r):
-            h.update(np.ascontiguousarray(a).tobytes())
+        hash_basis_operator(h, self.operator)
         h.update(f"{self.mode}|{self.pair}|{self.real}|{self.batch_size}"
                  f"|{self.n_states}|{self.n_padded}|v1".encode())
         self._fp_cache = h.hexdigest()

@@ -223,3 +223,22 @@ def test_distributed_compact_refusals():
             DistributedEngine(op2, n_devices=2, mode="compact")
     finally:
         update_config(complex_pair="auto")
+
+
+@needs_8
+@pytest.mark.parametrize("mode", ["ell", "compact"])
+def test_distributed_structure_cache(mode, tmp_path, rng):
+    """The distributed routing plan checkpoints and restores bit-identically,
+    keyed per mesh size (a D=4 plan must not satisfy a D=2 engine)."""
+    op = build_heisenberg(12, 6, 1, [([*range(1, 12), 0], 0)])
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    cache = str(tmp_path / "c.h5")
+    e1 = DistributedEngine(op, n_devices=4, mode=mode, structure_cache=cache)
+    assert not e1.structure_restored
+    y1 = e1.matvec_global(x)
+    e2 = DistributedEngine(op, n_devices=4, mode=mode, structure_cache=cache)
+    assert e2.structure_restored
+    np.testing.assert_array_equal(y1, e2.matvec_global(x))
+    e3 = DistributedEngine(op, n_devices=2, mode=mode, structure_cache=cache)
+    assert not e3.structure_restored
