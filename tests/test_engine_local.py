@@ -265,6 +265,39 @@ def test_structure_cache_roundtrip(tmp_path, rng):
                                atol=1e-13)
 
 
+def test_structure_cache_pair_roundtrip(tmp_path, rng):
+    """Pair-form (re,im)-f64 coefficient tables checkpoint and restore
+    bit-identically too (complex momentum sector)."""
+    from distributed_matvec_tpu.utils.config import get_config, update_config
+
+    path = str(tmp_path / "pair.h5")
+    op = build_heisenberg(12, 6, None,
+                          [([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 0], 2)])
+    op.basis.build()
+    N = op.basis.number_states
+    x = (rng.random(N) - 0.5) + 1j * (rng.random(N) - 0.5)
+    prev = get_config().complex_pair
+    update_config(complex_pair="on")
+    try:
+        e1 = LocalEngine(op, batch_size=61, mode="ell",
+                         structure_cache=path)
+        assert e1.pair and not e1.structure_restored
+        y1 = np.asarray(e1.matvec(x))
+        e2 = LocalEngine(op, batch_size=61, mode="ell",
+                         structure_cache=path)
+        assert e2.structure_restored
+        np.testing.assert_array_equal(y1, np.asarray(e2.matvec(x)))
+        # a native-c128 engine must NOT reuse the pair checkpoint
+        update_config(complex_pair="off")
+        e3 = LocalEngine(op, batch_size=61, mode="ell",
+                         structure_cache=path)
+        assert not e3.structure_restored
+        np.testing.assert_allclose(np.asarray(e3.matvec(x)), y1,
+                                   atol=1e-15, rtol=1e-14)
+    finally:
+        update_config(complex_pair=prev)
+
+
 def test_ell_split_cost_model_properties():
     """choose_ell_split: scatter-heavy layouts are rejected, truncation-only
     wins are kept, and degenerate histograms fall back to the full table."""
