@@ -62,23 +62,47 @@ def save_engine_structure(path: str, fingerprint: str, mode: str,
     function of (basis, operator, mode) — captured in ``fingerprint`` — so
     a rerun can restore it in I/O time.  Scalars go to attrs, arrays to
     datasets; None values are skipped.
+
+    The sidecar is written to a temp file in the same directory and then
+    ``os.replace``d onto ``path``: concurrent writers (every rank of a
+    multi-host driver constructing the same engine) each produce a complete
+    file and the rename is atomic, so a reader never observes an interleaved
+    half-write.  The fingerprint is still written last as a second line of
+    defence against a writer killed mid-save.
     """
+    import os
+    import tempfile
+
     h5py = _h5py()
-    # "w" truncates: the structure lives in its own (sidecar) file, so a
-    # rewrite reclaims space (h5py `del` would leave dead extents behind).
-    with h5py.File(path, "w") as f:
-        g = f.create_group("engine_structure")
-        g.attrs["mode"] = mode
-        for k, v in payload.items():
-            if v is None:
-                continue
-            if np.isscalar(v):
-                g.attrs[k] = v
-            else:
-                g.create_dataset(k, data=np.asarray(v))
-        # fingerprint LAST: a partially written file (killed mid-save) then
-        # fails the fingerprint check instead of restoring garbage
-        g.attrs["fingerprint"] = fingerprint
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(suffix=".h5.tmp", dir=dirname)
+    os.close(fd)
+    # mkstemp creates 0600; widen to a conventional checkpoint mode so the
+    # rename does not narrow readability vs the previous in-place h5py
+    # create (reading the umask would mutate process-global state under
+    # JAX's background threads, so use a fixed mode)
+    os.chmod(tmp, 0o644)
+    try:
+        with h5py.File(tmp, "w") as f:
+            g = f.create_group("engine_structure")
+            g.attrs["mode"] = mode
+            for k, v in payload.items():
+                if v is None:
+                    continue
+                if np.isscalar(v):
+                    g.attrs[k] = v
+                else:
+                    g.create_dataset(k, data=np.asarray(v))
+            # fingerprint LAST: a partially written file (killed mid-save)
+            # then fails the fingerprint check instead of restoring garbage
+            g.attrs["fingerprint"] = fingerprint
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_engine_structure(path: str, fingerprint: str) -> Optional[dict]:

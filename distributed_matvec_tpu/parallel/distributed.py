@@ -69,7 +69,7 @@ from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
 from .engine import (SENTINEL_STATE, check_complex_backend, choose_ell_split,
-                     unroll_terms_ok, use_pair_complex)
+                     compact_magnitude, unroll_terms_ok, use_pair_complex)
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
 
@@ -394,14 +394,7 @@ class DistributedEngine:
             raise ValueError(
                 "compact mode requires a real sector (use mode='ell' for "
                 "complex-character momentum sectors)")
-        sample = self.operator.basis.representatives[:4096]
-        _, amps = self.operator.apply_off_diag(sample)
-        vals = np.unique(np.abs(amps[amps != 0]))
-        if vals.size != 1:
-            raise ValueError(
-                f"compact mode needs a single off-diagonal magnitude, "
-                f"found {vals[:5]}; use mode='ell'")
-        W = float(vals[0])
+        W = compact_magnitude(self.operator)
         self._c_W = W
 
         g_idx, coeffs, owners, idxs, queries, qin = self._host_plan(

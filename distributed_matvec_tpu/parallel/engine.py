@@ -171,6 +171,29 @@ def hash_basis_operator(h, operator) -> None:
         h.update(np.ascontiguousarray(a).tobytes())
 
 
+def compact_magnitude(operator, sample_size: int = 4096) -> float:
+    """The single off-diagonal magnitude W compact mode assumes, derived from
+    a sample of rows *strided across the whole basis* (not just its head —
+    an operator whose anisotropy only shows up deep in the basis should be
+    refused here, cheaply, rather than after a minutes-long count/pack pass).
+    Correctness never depends on this: every entry is re-validated against W
+    during the pack.  Shared by the local and distributed engines so their
+    sample policies cannot drift."""
+    reps = operator.basis.representatives
+    n = reps.shape[0]
+    if n <= sample_size:
+        sample = reps
+    else:
+        sample = reps[np.linspace(0, n - 1, sample_size).astype(np.int64)]
+    _, amps = operator.apply_off_diag(np.ascontiguousarray(sample))
+    vals = np.unique(np.abs(amps[amps != 0]))
+    if vals.size != 1:
+        raise ValueError(
+            f"compact mode needs a single off-diagonal magnitude, "
+            f"found {vals[:5]}; use mode='ell'")
+    return float(vals[0])
+
+
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):
     pad = n_pad - reps.size
     alphas = np.concatenate([reps, np.full(pad, SENTINEL_STATE, np.uint64)])
@@ -697,14 +720,7 @@ class LocalEngine:
         n_pad = self.n_padded
         n = self.n_states
 
-        sample = self.operator.basis.representatives[: min(n, 4096)]
-        _, amps = self.operator.apply_off_diag(sample)
-        vals = np.unique(np.abs(amps[amps != 0]))
-        if vals.size != 1:
-            raise ValueError(
-                f"compact mode needs a single off-diagonal magnitude, "
-                f"found {vals[:5]}; use mode='ell'")
-        W = float(vals[0])
+        W = compact_magnitude(self.operator)
         self._c_W = W
 
         hist, nnz_chunks = self._count_row_nnz(alphas_c, norms_c)

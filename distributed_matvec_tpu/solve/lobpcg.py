@@ -37,7 +37,9 @@ def _norm_estimate(matvec: Callable, n: int, iters: int = 20, seed: int = 3):
 def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
            tol: float = 1e-9, seed: int = 0,
            X0: Optional[np.ndarray] = None,
-           pair: Optional[bool] = None
+           pair: Optional[bool] = None,
+           cluster_rtol: float = 1e-6,
+           rank_tol: float = 0.3
            ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Lowest-``k`` eigenpairs via spectrum-flipped LOBPCG.
 
@@ -48,6 +50,13 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     operator on R^{2n}: each complex eigenvalue appears twice (along v and
     J·v), so the block is doubled to 2k and complex-parallel duplicates are
     filtered from the result; eigenvectors come back complex ``[n, k]``.
+    J-copies are decided *per eigenvalue cluster* (eigenvalues within
+    ``cluster_rtol``·‖H‖ of each other): each cluster's complexified
+    columns are projected against every already-kept vector and then
+    rank-decided by column-pivoted QR, keeping columns whose independent
+    residual exceeds ``rank_tol`` — so a near-threshold residual on one
+    column cannot silently drop a genuine degenerate partner the way a
+    fixed per-column scalar cutoff could.
     """
     from jax.experimental.sparse.linalg import lobpcg_standard
 
@@ -128,26 +137,48 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     evals = sigma - np.asarray(theta)
     order = np.argsort(evals)
     evals, U = evals[order], np.asarray(U)[:, order]
-    # Complex view; keep one representative per complex direction.  A J-copy
-    # of a kept vector lies entirely in the complex span of the kept set at
-    # that eigenvalue, so complex Gram-Schmidt against the kept vectors
-    # leaves ~zero residual for copies while a genuinely degenerate partner
-    # retains an O(1) independent component (which we keep, orthonormalized —
-    # so returned vectors are complex-orthonormal even within degenerate
-    # clusters).
+    # Complex view; keep one representative per complex direction.  Columns
+    # are processed per eigenvalue *cluster*: each cluster block is first
+    # projected against ALL previously kept vectors (so a J-copy whose
+    # eigenvalue estimate drifted into a later cluster still deduplicates),
+    # then column-pivoted QR ranks the residual columns — a copy's residual
+    # is ~0 while a genuinely degenerate partner keeps an O(1) independent
+    # component, and within a cluster the partner with the LARGEST residual
+    # is decided first, so a noisy copy processed earlier cannot push a
+    # genuine partner under the threshold (the per-column scalar-cutoff
+    # failure mode).  Pivoted QR keeps (orthonormalized) *actual columns*
+    # rather than SVD mixtures, so near-degenerate-but-distinct eigenpairs
+    # that share a cluster are not 50/50 blended, and each kept vector
+    # carries the eigenvalue of its own pivot column.
+    from scipy.linalg import qr as _pivoted_qr
+
     Z = U.reshape(n, 2, kk)[:, 0] + 1j * U.reshape(n, 2, kk)[:, 1]
+    Z = Z / np.maximum(np.linalg.norm(Z, axis=0, keepdims=True), 1e-300)
+    gap = cluster_rtol * max(abs(sigma), 1.0)
     kept_vals, kept_vecs = [], []
-    for j in range(kk):
-        z = Z[:, j] / np.linalg.norm(Z[:, j])
-        for z0 in kept_vecs:
-            z = z - np.vdot(z0, z) * z0
-        r = np.linalg.norm(z)
-        if r < 0.3:
-            continue                       # complex-parallel J-copy
-        kept_vals.append(evals[j])
-        kept_vecs.append(z / r)
-        if len(kept_vals) == k:
-            break
+    j = 0
+    while j < kk and len(kept_vals) < k:
+        j_end = j + 1
+        while j_end < kk and evals[j_end] - evals[j_end - 1] <= gap:
+            j_end += 1
+        Zc = Z[:, j:j_end].copy()
+        if kept_vecs:
+            Qm = np.stack(kept_vecs, axis=1)
+            Zc -= Qm @ (Qm.conj().T @ Zc)
+        Qc, R, piv = _pivoted_qr(Zc, mode="economic", pivoting=True)
+        diag = np.abs(np.diag(R))
+        for r_i in range(diag.size):
+            if diag[r_i] <= rank_tol or len(kept_vals) == k:
+                break
+            kept_vals.append(evals[j + piv[r_i]])
+            kept_vecs.append(Qc[:, r_i])
+        j = j_end
+    if kept_vals:
+        # pivot order within a cluster is by residual norm, not eigenvalue —
+        # restore the documented ascending contract (pairing preserved)
+        asc = np.argsort(kept_vals)
+        kept_vals = [kept_vals[i] for i in asc]
+        kept_vecs = [kept_vecs[i] for i in asc]
     if len(kept_vals) < k:
         import warnings
         warnings.warn(
