@@ -179,13 +179,38 @@ def main(argv=None):
 
     if args.observables and cfg.observables and evec_rows is not None:
         # ⟨ψ₀|O|ψ₀⟩ per observable, printed and saved under /observables —
-        # the output group the reference driver creates (Diagonalize.chpl:276-279)
+        # the output group the reference driver creates (Diagonalize.chpl:276-279).
+        # Each observable gets its own *fused-mode* engine: no structure
+        # build (the ELL pack costs minutes at scale and would be paid per
+        # observable), device-speed apply — the analog of the reference
+        # keeping observables inside its exported kernels
+        # (LatticeSymmetries.chpl:16-31) instead of a host path.
         from distributed_matvec_tpu.io.hdf5 import save_observables
 
         psi = evec_rows[0]
-        values = [(obs.name or f"observable_{k}",
-                   np.vdot(psi, obs.matvec_host(psi)).real)
-                  for k, obs in enumerate(cfg.observables)]
+        xh_cache = {}
+
+        def expectation(obs):
+            if args.devices and args.devices > 1:
+                from distributed_matvec_tpu.parallel.distributed import (
+                    DistributedEngine)
+                # share H's mesh and hash layout (pure functions of the
+                # basis + device count) and reuse the shuffled |psi> per
+                # pair-ness — only the fused kernel tables differ per
+                # observable
+                oeng = DistributedEngine(obs, mesh=eng.mesh, mode="fused",
+                                         layout=eng.layout)
+                if oeng.pair not in xh_cache:
+                    xh_cache[oeng.pair] = oeng.to_hashed(psi)
+                xh = xh_cache[oeng.pair]
+                return float(np.real(complex(oeng.dot(xh, oeng.matvec(xh)))))
+            from distributed_matvec_tpu.parallel.engine import LocalEngine
+            oeng = LocalEngine(obs, mode="fused")
+            return float(np.real(np.vdot(psi, np.asarray(oeng.matvec(psi)))))
+
+        with timer.scope("observables"):
+            values = [(obs.name or f"observable_{k}", expectation(obs))
+                      for k, obs in enumerate(cfg.observables)]
         for name, val in save_observables(out, values).items():
             print(f"  <{name}> = {val:.12f}")
 

@@ -104,7 +104,8 @@ class DistributedEngine:
                  n_devices: Optional[int] = None,
                  batch_size: Optional[int] = None,
                  mode: Optional[str] = None,
-                 structure_cache: Optional[str] = None):
+                 structure_cache: Optional[str] = None,
+                 layout: Optional[HashedLayout] = None):
         basis = operator.basis
         if not basis.is_built:
             basis.build()
@@ -133,7 +134,18 @@ class DistributedEngine:
 
         reps, norms = basis.representatives, basis.norms
         D = self.n_devices
-        self.layout = HashedLayout(reps, D)
+        # several engines over the SAME basis (H + observables) can share
+        # one layout: the hash partition is a pure function of (reps, D),
+        # so recomputing it per engine would repeat O(N) host hashing
+        if layout is not None:
+            if layout.n_shards != D or layout.n_global != reps.size:
+                raise ValueError(
+                    f"shared layout is for {layout.n_global} states on "
+                    f"{layout.n_shards} shards, engine needs {reps.size} "
+                    f"on {D}")
+            self.layout = layout
+        else:
+            self.layout = HashedLayout(reps, D)
         M = self.layout.shard_size
         self.n_states = reps.size
         self.shard_size = M
@@ -211,243 +223,314 @@ class DistributedEngine:
         self.timer.report()  # tree print, gated by display_timings
 
     # ------------------------------------------------------------------
-    # ELL mode: static routing plan
+    # ELL/compact modes: static routing plan (streaming two-pass build)
     # ------------------------------------------------------------------
 
-    def _host_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray):
-        """Compute per-shard neighbor structure + the cross-shard query plan.
+    def _shard_addressable(self, d: int) -> bool:
+        """Whether mesh device ``d`` belongs to THIS process — in a
+        multi-controller run each process packs and supplies only its own
+        shards (the SPMD per-locale setup of Diagonalize.chpl:298-325)."""
+        devs = list(self.mesh.devices.flat)
+        return devs[d].process_index == jax.process_index()
+
+    def _put_shard(self, piece, d):
+        """One shard's host piece → a [1, ...] single-device array on mesh
+        device ``d`` (the unit :meth:`_assemble_sharded` stitches), or
+        None when ``d`` belongs to another process."""
+        if not self._shard_addressable(d):
+            return None
+        devs = list(self.mesh.devices.flat)
+        return jax.device_put(
+            np.ascontiguousarray(np.asarray(piece))[None], devs[d])
+
+    def _assemble_sharded(self, shards):
+        """[D, ...] device array from per-shard pieces via
+        ``make_array_from_single_device_arrays`` — no global host copy
+        exists at any point, and in a multi-process run each process only
+        supplies its own addressable shards (None placeholders stand in
+        for remote ones).  Pieces may be host arrays or already-placed
+        ``_put_shard`` results (so builders can ship each shard to its
+        device as soon as it is packed and free the host staging before
+        packing the next one)."""
+        D = self.n_devices
+        arrs, shape_tail = [], None
+        for d, s in enumerate(shards):
+            if s is None:
+                continue
+            a = s if isinstance(s, jax.Array) else self._put_shard(s, d)
+            if a is not None:
+                arrs.append(a)
+                shape_tail = a.shape[1:]
+        spec = shard_spec(self.mesh, len(shape_tail) + 1)
+        return jax.make_array_from_single_device_arrays(
+            (D,) + shape_tail, spec, arrs)
+
+    def _plan_stream(self, alphas_h: np.ndarray, norms_h: np.ndarray,
+                     compact: bool) -> None:
+        """Memory-bounded two-pass routing-plan build (ELL and compact).
 
         Replaces the reference's per-matvec radix partition + buffer routing
         (DistributedMatrixVector.chpl:265-311, :559-735) with a one-time
-        host-coordinated exchange of *static* query lists.  Returns
-        ``(g_idx, coeffs, owners, idxs, queries, qin)`` — shared by the ELL
-        and compact uploads.
+        static query plan — built STREAMING: the dense predecessor
+        materialized [D, M, T] owner/index/coefficient arrays on the host
+        (N·T·16 B ≈ 36 GB at chain_36_symm) and walked D² Python query
+        lists; here the device kernel streams row chunks twice, pass 1
+        keeping only per-row nnz counts and a per-peer uniqueness mask of
+        remote targets, pass 2 packing entries straight into per-shard
+        final tables that go to their device one shard at a time.  Peak
+        host staging is O(B·T) chunk scratch + one shard's packed table —
+        the distributed analog of :meth:`LocalEngine._build_ell_lowmem`,
+        honoring the reference's bounded-buffer property
+        (DistributedMatrixVector.chpl:456) at build time.
+
+        Remote queries are DEDUPLICATED per (shard, peer): entries reading
+        the same remote x share one exchange slot, so the per-apply
+        ``all_to_all`` moves at most M values per peer pair instead of one
+        per matrix element (the dense plan gave every reference its own
+        slot — a ~T× larger exchange for dense operators).
         """
         D, M, T = self.n_devices, self.shard_size, self.num_terms
         from ..enumeration.host import hash64 as hash64_host
 
+        Bc = min(M, max(self.batch_size, 8))
+        nchunks = (M + Bc - 1) // Bc
+
         @jax.jit
-        def build_shard(tables, alphas, norms_a):
-            # orbit scan on device; owner hash + index lookup on host below
+        def gather_chunk(tables, alphas, norms_a):
             return K.gather_coefficients(tables, alphas, norms_a)
 
-        owners = np.empty((D, M, T), np.int32)
-        idxs = np.empty((D, M, T), np.int32)
-        coeffs = np.empty((D, M, T),
-                          np.float64 if self.real else np.complex128)
+        def chunks(d):
+            """Yield (s, e, n_c, betas, cf, nz, owner) per row chunk, all
+            padded to Bc rows (SENTINEL rows carry cf == 0)."""
+            for ci in range(nchunks):
+                s, e = ci * Bc, min((ci + 1) * Bc, M)
+                a_c, n_c = alphas_h[d][s:e], norms_h[d][s:e]
+                if e - s < Bc:
+                    a_c = np.concatenate(
+                        [a_c, np.full(Bc - (e - s), SENTINEL_STATE,
+                                      np.uint64)])
+                    n_c = np.concatenate([n_c, np.ones(Bc - (e - s))])
+                betas_d, cf_d = gather_chunk(
+                    self.tables, jnp.asarray(a_c), jnp.asarray(n_c))
+                betas, cf = np.asarray(betas_d), np.asarray(cf_d)
+                if self.pair:
+                    # plan building is host-side math — c128 is fine here
+                    cf = K.complex_from_pair(cf)
+                nz = (cf != 0) & (a_c != SENTINEL_STATE)[:, None]
+                owner = ((hash64_host(betas) % np.uint64(D)).astype(np.int32)
+                         if D > 1 else np.zeros(betas.shape, np.int32))
+                yield s, e, n_c, betas, cf, nz, np.where(nz, owner, -1)
+
+        # -- pass 1: row-nnz counts, remote-target dedup, sector check -----
+        nnz = np.zeros((D, M), np.int32)
+        queries = [[None] * D for _ in range(D)]
         bad = 0
         for d in range(D):
-            betas_d, coeff_d = build_shard(self.tables,
-                                           jnp.asarray(alphas_h[d]),
-                                           jnp.asarray(norms_h[d]))
-            betas = np.asarray(betas_d)
-            cf = np.asarray(coeff_d)
-            if self.pair:
-                # the plan is host-side math — complex128 is fine here
-                cf = K.complex_from_pair(cf)
-            owner = (hash64_host(betas) % np.uint64(D)).astype(np.int32) \
-                if D > 1 else np.zeros(betas.shape, np.int32)
-            idx = np.zeros(betas.shape, np.int64)
-            found = np.zeros(betas.shape, bool)
+            mark = np.zeros((D, M), bool)   # remote targets seen, per peer
+            for s, e, n_c, betas, cf, nz, owner in chunks(d):
+                nnz[d, s:e] = nz.sum(axis=1)[: e - s]
+                for p in range(D):
+                    sel = owner == p
+                    if not sel.any():
+                        continue
+                    b_p = betas[sel]
+                    ip = np.searchsorted(alphas_h[p], b_p)
+                    np.clip(ip, 0, M - 1, out=ip)
+                    ok = alphas_h[p][ip] == b_p
+                    bad += int((~ok).sum())
+                    if p != d:
+                        mark[p, ip[ok]] = True
+                log_debug(f"plan pass1 shard {d}: rows {e}/{M}")
             for p in range(D):
-                sel = owner == p
-                ip = np.searchsorted(alphas_h[p], betas[sel])
-                np.clip(ip, 0, M - 1, out=ip)
-                idx[sel] = ip
-                found[sel] = alphas_h[p][ip] == betas[sel]
-            valid_row = (alphas_h[d] != SENTINEL_STATE)[:, None]
-            nz = (cf != 0) & valid_row
-            bad += int((nz & ~found).sum())
-            nz &= found
-            cf = np.where(nz, cf, 0)  # np.asarray(jax) views are read-only
-            idx = np.where(nz, idx, 0)
-            owner = np.where(nz, owner, -1)
-            owners[d], idxs[d], coeffs[d] = owner, idx.astype(np.int32), cf
+                if p != d:
+                    queries[d][p] = np.flatnonzero(mark[p]).astype(np.int32)
         if bad:
             raise RuntimeError(
                 f"{bad} generated matrix elements map outside the basis — "
                 "operator does not preserve the chosen sector"
             )
 
-        # Host: per-(d, p) query lists Q[d][p] = local indices on p that d
-        # reads, in row-major (m, t) order.
-        queries = [[None] * D for _ in range(D)]
-        for d in range(D):
-            od, id_ = owners[d].reshape(-1), idxs[d].reshape(-1)
-            for p in range(D):
-                if p == d:
-                    continue
-                queries[d][p] = id_[od == p]
-        cap = max((q.size for row in queries for q in row if q is not None),
-                  default=0)
-        C = _round_up(cap, 8)
-        self.query_capacity = C
-        remote_total = sum(q.size for row in queries for q in row if q is not None)
-        log_debug(f"routing plan: D={D} M={M} T={T} capacity={C} "
-                  f"remote_elements={remote_total}")
-
-        # g_idx: per entry, position in concat(x_local [M], R.flat [D*C]).
-        g_idx = np.zeros((D, M, T), np.int32)
-        for d in range(D):
-            od = owners[d].reshape(-1)
-            id_ = idxs[d].reshape(-1)
-            gi = np.zeros(od.shape, np.int64)
-            local = od == d
-            gi[local] = id_[local]
-            for p in range(D):
-                if p == d:
-                    continue
-                sel = od == p
-                k = np.arange(sel.sum())
-                gi[sel] = M + p * C + k
-            g_idx[d] = gi.reshape(M, T)
-
-        # qin[d][q] = Q[q][d] — what peer q asked this shard for (0-padded).
-        qin = np.zeros((D, D, C), np.int32)
-        for d in range(D):
-            for q in range(D):
-                if q == d or queries[q][d] is None:
-                    continue
-                qq = queries[q][d]
-                qin[d, q, : qq.size] = qq
-        self._qin = jax.device_put(jnp.asarray(qin),
-                                   shard_spec(self.mesh, 3))
-        return g_idx, coeffs, owners, idxs, queries, qin
-
-    def _build_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray) -> None:
-        """ELL upload of the host plan: packed f64/c128 coefficient tables."""
-        g_idx, coeffs, _, _, _, qin = self._host_plan(alphas_h, norms_h)
-        g_idx, coeffs, tail = self._split_tables(g_idx, coeffs)
-        sh3 = shard_spec(self.mesh, 3)
-        # Transposed [T0, M(, 2)] per shard (see LocalEngine layout note);
-        # pair mode uploads (re, im)-f64 instead of c128.
-        cf_up = np.swapaxes(coeffs, 1, 2)
-        if self.pair:
-            cf_up = K.pair_from_complex(cf_up)
-        self._ell_idx = jax.device_put(
-            jnp.asarray(np.swapaxes(g_idx, 1, 2)), sh3)
-        self._ell_coeff = jax.device_put(
-            jnp.asarray(cf_up), shard_spec(self.mesh, cf_up.ndim))
-        if tail is None:
-            self._ell_tail = None
-        else:
-            rows_t, idx_t, cf_t = tail
-            if self.pair:
-                cf_t = K.pair_from_complex(cf_t)
-            self._ell_tail = tuple(
-                jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
-                for a in (rows_t, idx_t, cf_t))
-
-    def _split_tables(self, g_idx: np.ndarray, coeffs: np.ndarray):
-        """Two-level split of the [D, M, T] tables (host-side analog of
-        ``LocalEngine._split_ell``): pack each row's nonzeros left, keep a
-        width-``T0`` main table plus a tail over the rows wider than T0.
-        ``T0`` is global (static shapes under shard_map); per-shard tail rows
-        are padded to the widest shard with (row 0, coeff 0) no-ops.  Tail
-        entries are scatter-accumulated, hence the 2× cost weight and the
-        ≤ N/4-rows constraint.
-        """
-        D, M, T = coeffs.shape
-        self._ell_T0 = T
-        if M == 0 or T == 0:
-            return g_idx, coeffs, None
-        nnz = (coeffs != 0).sum(axis=2)                     # [D, M]
         hist = np.bincount(nnz.reshape(-1), minlength=T + 1)
         T0, S, Tmax = choose_ell_split(hist, D * M, T,
                                        real_rows=self.n_states)
         self._ell_T0 = T0
-        log_debug(f"distributed ell split: T={T} Tmax={Tmax} T0={T0} "
-                  f"tail_rows={S}")
-        if T0 == T:
-            return g_idx, coeffs, None
+        Tw = Tmax - T0 if S else 0
+        cap = max((q.size for row in queries for q in row if q is not None),
+                  default=0)
+        C = _round_up(cap, 8)
+        self.query_capacity = C
+        remote_unique = sum(q.size for row in queries
+                            for q in row if q is not None)
+        log_debug(f"routing plan: D={D} M={M} T={T} T0={T0} tail={S} "
+                  f"capacity={C} remote_unique={remote_unique}")
 
-        order = np.argsort(coeffs == 0, axis=2, kind="stable")   # [D, M, T]
-        g_p = np.take_along_axis(g_idx, order, axis=2)
-        c_p = np.take_along_axis(coeffs, order, axis=2)
-        if S == 0:
-            return g_p[:, :, :T0], c_p[:, :, :T0], None
-
-        S_max = int((nnz > T0).sum(axis=1).max())
-        Tw = Tmax - T0
-        rows = np.zeros((D, S_max), np.int32)
-        idx_t = np.zeros((D, Tw, S_max), np.int32)
-        cf_t = np.zeros((D, Tw, S_max), coeffs.dtype)
+        # qin[d][q] = the local indices peer q reads from this shard
+        # (0-padded); sorted-unique order fixed by pass 1.
+        qin_shards = []
         for d in range(D):
-            rd = np.nonzero(nnz[d] > T0)[0]
-            rows[d, : rd.size] = rd
-            idx_t[d, :, : rd.size] = g_p[d, rd, T0:Tmax].T
-            cf_t[d, :, : rd.size] = c_p[d, rd, T0:Tmax].T
-        return g_p[:, :, :T0], c_p[:, :, :T0], (rows, idx_t, cf_t)
+            qd = np.zeros((D, C), np.int32)
+            for q in range(D):
+                if q != d and queries[q][d] is not None:
+                    qd[q, : queries[q][d].size] = queries[q][d]
+            qin_shards.append(qd)
+        self._qin = self._assemble_sharded(qin_shards)
+
+        W = self._c_W if compact else 0.0
+        cdtype = np.float64 if self.real else np.complex128
+        S_max = int((nnz > T0).sum(axis=1).max()) if S else 0
+
+        # -- pass 2: pack per-shard tables, one shard resident at a time ---
+        idx_shards, cf_shards = [], []
+        trow_shards, tidx_shards, tcf_shards = [], [], []
+        n_all_shards = []
+        badw = 0
+        for d in range(D):
+            if not self._shard_addressable(d):
+                # another process packs this shard; keep list positions
+                for lst in (idx_shards, cf_shards, trow_shards,
+                            tidx_shards, tcf_shards, n_all_shards):
+                    lst.append(None)
+                continue
+            # slot[p][i] = exchange slot of local index i on peer p
+            slot = np.zeros((D, M), np.int32)
+            for p in range(D):
+                q = queries[d][p]
+                if p != d and q is not None and q.size:
+                    slot[p, q] = np.arange(q.size, dtype=np.int32)
+            g_main = None if compact else np.zeros((T0, M), np.int32)
+            v_main = (np.zeros((T0, M), np.int32) if compact
+                      else np.zeros((T0, M), cdtype))
+            rows_t = np.zeros(S_max, np.int32)
+            v_tail = (np.zeros((Tw, S_max), np.int32) if compact
+                      else np.zeros((Tw, S_max), cdtype))
+            i_tail = None if compact else np.zeros((Tw, S_max), np.int32)
+            t_cursor = 0
+            for s, e, n_c, betas, cf, nz, owner in chunks(d):
+                g = np.zeros(betas.shape, np.int64)
+                n_b = np.ones(betas.shape) if compact else None
+                for p in range(D):
+                    sel = owner == p
+                    if not sel.any():
+                        continue
+                    ip = np.searchsorted(alphas_h[p], betas[sel])
+                    np.clip(ip, 0, M - 1, out=ip)
+                    g[sel] = ip if p == d else M + p * C + slot[p, ip]
+                    if compact:
+                        n_b[sel] = norms_h[p][ip]
+                cfz = np.where(nz, cf, 0)
+                if compact:
+                    ratio = np.abs(cfz) * n_c[:, None] / n_b
+                    badw += int((nz & (np.abs(ratio - W) > 1e-9 * W)).sum())
+                order = np.argsort(~nz, axis=1, kind="stable")
+                g_p = np.take_along_axis(np.where(nz, g, 0), order, axis=1)
+                c_p = np.take_along_axis(cfz, order, axis=1)
+                r = e - s
+
+                def pack(gg, cc):
+                    if compact:
+                        return np.where(
+                            cc != 0,
+                            np.sign(cc.real).astype(np.int32)
+                            * (gg.astype(np.int32) + 1), 0)
+                    return cc
+
+                if not compact:
+                    g_main[:, s:e] = g_p[:r, :T0].T
+                v_main[:, s:e] = pack(g_p[:r, :T0], c_p[:r, :T0]).T
+                if S:
+                    rd = np.nonzero(nnz[d, s:e] > T0)[0]
+                    if rd.size:
+                        tsl = slice(t_cursor, t_cursor + rd.size)
+                        rows_t[tsl] = (s + rd).astype(np.int32)
+                        if not compact:
+                            i_tail[:, tsl] = g_p[rd, T0:Tmax].T
+                        v_tail[:, tsl] = pack(g_p[rd, T0:Tmax],
+                                              c_p[rd, T0:Tmax]).T
+                        t_cursor += rd.size
+                log_debug(f"plan pass2 shard {d}: rows {e}/{M}")
+            # ship this shard's tables to its device NOW so the host
+            # staging above is freed before the next shard packs
+            if compact:
+                idx_shards.append(self._put_shard(v_main, d))  # sign tags
+            else:
+                idx_shards.append(self._put_shard(g_main, d))
+                cf_shards.append(self._put_shard(
+                    K.pair_from_complex(v_main) if self.pair else v_main, d))
+            if S:
+                trow_shards.append(self._put_shard(rows_t, d))
+                if compact:
+                    tidx_shards.append(self._put_shard(v_tail, d))
+                else:
+                    tidx_shards.append(self._put_shard(i_tail, d))
+                    tcf_shards.append(self._put_shard(
+                        K.pair_from_complex(v_tail) if self.pair else v_tail,
+                        d))
+            if compact:
+                n_all_d = np.ones(M + D * C if D > 1 else M)
+                n_all_d[:M] = norms_h[d]
+                for p in range(D):
+                    q = queries[d][p]
+                    if p != d and q is not None and q.size:
+                        n_all_d[M + p * C: M + p * C + q.size] = \
+                            norms_h[p][q]
+                n_all_shards.append(n_all_d)
+        if badw:
+            raise RuntimeError(
+                f"{badw} matrix elements violate the ±W·n(j)/n(i) form "
+                f"(W={W}); the operator does not qualify for compact mode "
+                "— use mode='ell'"
+            )
+
+        if compact:
+            self._c_idx = self._assemble_sharded(idx_shards)   # [D, T0, M]
+            self._c_tail = None
+            if S:
+                self._c_tail = (self._assemble_sharded(trow_shards),
+                                self._assemble_sharded(tidx_shards))
+            if jax.process_count() == 1:
+                n_all = np.stack(n_all_shards)
+                self._finish_compact_aux(n_all, norms_h)
+                self._c_n_all = n_all  # kept only until _save_structure runs
+            else:
+                # multi-controller: no process holds the global n_all —
+                # assemble it device-side from local shards (structure
+                # checkpointing is single-process only, so no host copy
+                # is needed)
+                self._finish_compact_aux(
+                    self._assemble_sharded(n_all_shards), norms_h)
+                self._c_n_all = None
+        else:
+            self._ell_idx = self._assemble_sharded(idx_shards)
+            self._ell_coeff = self._assemble_sharded(cf_shards)
+            self._ell_tail = None
+            if S:
+                self._ell_tail = (self._assemble_sharded(trow_shards),
+                                  self._assemble_sharded(tidx_shards),
+                                  self._assemble_sharded(tcf_shards))
+
+    def _build_plan(self, alphas_h: np.ndarray, norms_h: np.ndarray) -> None:
+        """ELL plan: packed f64/c128 coefficient tables ([D, T0, M(, 2)]
+        transposed upload, see LocalEngine layout note) + tail."""
+        self._plan_stream(alphas_h, norms_h, compact=False)
 
     def _build_compact_plan(self, alphas_h: np.ndarray,
                             norms_h: np.ndarray) -> None:
-        """Compact upload of the host plan: sign-tagged 4 B/entry indices.
+        """Compact plan: sign-tagged 4 B/entry indices.
 
         Mirrors :meth:`LocalEngine._build_compact` across shards: for real
         sectors with one off-diagonal magnitude W, the coefficient
         ``W·s·n(j)/n(i)`` is derived at matvec time, with n(j) looked up in
-        a STATIC concat(n_local, n_remote) table — remote norms never change,
-        so only x values ride the per-apply ``all_to_all`` (same exchange as
-        ELL mode).  Validated entry-by-entry on the host plan.
+        a STATIC concat(n_local, n_remote) table — remote norms never
+        change, so only x values ride the per-apply ``all_to_all`` (same
+        exchange as ELL mode).  Every entry is validated against W during
+        the pack pass.
         """
         if not self.real or self.pair:
             raise ValueError(
                 "compact mode requires a real sector (use mode='ell' for "
                 "complex-character momentum sectors)")
-        W = compact_magnitude(self.operator)
-        self._c_W = W
-
-        g_idx, coeffs, owners, idxs, queries, qin = self._host_plan(
-            alphas_h, norms_h)
-        D, M = self.n_devices, self.shard_size
-        C = self.query_capacity
-
-        # validate |coeff| == W·n(j)/n(i) on the host plan
-        n_b = np.ones_like(coeffs, dtype=np.float64)
-        for p in range(D):
-            sel = owners == p
-            n_b[sel] = norms_h[p][idxs[sel]]
-        live = coeffs != 0
-        ratio = np.abs(coeffs) * norms_h[:, :, None] / n_b
-        bad = int((live & (np.abs(ratio - W) > 1e-9 * W)).sum())
-        if bad:
-            raise RuntimeError(
-                f"{bad} matrix elements violate the ±W·n(j)/n(i) form "
-                f"(W={W}); the operator does not qualify for compact mode "
-                "— use mode='ell'"
-            )
-
-        # pack with the shared splitter, then convert to sign tags
-        g_p, c_p, tail = self._split_tables(g_idx, coeffs)
-        tags = np.where(c_p != 0,
-                        np.sign(c_p).astype(np.int32)
-                        * (g_p.astype(np.int32) + 1), 0)
-        sh3 = shard_spec(self.mesh, 3)
-        self._c_idx = jax.device_put(
-            jnp.asarray(np.swapaxes(tags, 1, 2)), sh3)      # [D, T0, M]
-        if tail is None:
-            self._c_tail = None
-        else:
-            rows_t, idx_t, cf_t = tail
-            tag_t = np.where(cf_t != 0,
-                             np.sign(cf_t).astype(np.int32)
-                             * (idx_t.astype(np.int32) + 1), 0)
-            self._c_tail = tuple(
-                jax.device_put(jnp.asarray(a), shard_spec(self.mesh, a.ndim))
-                for a in (rows_t, tag_t))
-
-        # static norm table over the gather space: concat(x_local, R) for
-        # D > 1, x_local alone on a single shard (no exchange happens)
-        n_all = np.ones((D, M + D * C if D > 1 else M))
-        n_all[:, :M] = norms_h
-        for d in range(D):
-            for p in range(D):
-                q = queries[d][p]
-                if q is None or q.size == 0:
-                    continue
-                n_all[d, M + p * C: M + p * C + q.size] = norms_h[p][q]
-        self._finish_compact_aux(n_all, norms_h)
-        self._c_n_all = n_all    # kept only until _save_structure runs
+        self._c_W = compact_magnitude(self.operator)
+        self._plan_stream(alphas_h, norms_h, compact=True)
 
     def _finish_compact_aux(self, n_all: np.ndarray,
                             norms_h: Optional[np.ndarray] = None) -> None:
@@ -490,13 +573,18 @@ class DistributedEngine:
         h = hashlib.sha256()
         hash_basis_operator(h, self.operator)
         h.update(f"dist|{self.mode}|{self.pair}|{self.real}"
-                 f"|{self.n_devices}|{self.shard_size}|v1".encode())
+                 f"|{self.n_devices}|{self.shard_size}|v2".encode())
         self._fp_cache = h.hexdigest()
         return self._fp_cache
 
     def _try_load_structure(self, path: Optional[str],
                             norms_h: Optional[np.ndarray] = None) -> bool:
         if not path:
+            return False
+        if jax.process_count() > 1:
+            # the checkpoint holds GLOBAL arrays; a multi-controller rank
+            # can neither restore nor write them whole
+            log_debug("structure cache disabled in multi-process runs")
             return False
         import os
 
@@ -537,7 +625,7 @@ class DistributedEngine:
         return True
 
     def _save_structure(self, path: Optional[str]) -> None:
-        if not path:
+        if not path or jax.process_count() > 1:
             return
         from ..io.hdf5 import save_engine_structure
 
@@ -728,9 +816,10 @@ class DistributedEngine:
     # Fused mode: dynamic bucketing + all_to_all + segment_sum
     # ------------------------------------------------------------------
 
-    def _fused_capacity(self) -> int:
+    def _fused_capacity(self, batch_rows: Optional[int] = None) -> int:
         cfg = get_config()
-        D, T, B = self.n_devices, self.num_terms, self.batch_size
+        D, T = self.n_devices, self.num_terms
+        B = batch_rows or self.batch_size
         total = B * max(T, 1)
         if D == 1:
             return _round_up(total, 8)
@@ -741,132 +830,156 @@ class DistributedEngine:
 
     def _make_fused_matvec(self):
         D, M, T = self.n_devices, self.shard_size, self.num_terms
-        B = self.batch_size
-        Cap = self._capacity
-        nchunks = M // B if M % B == 0 else M // B + 1
-        Mp = nchunks * B
         dtype = self._dtype
         lk_shift, lk_probes = self._lk_shift, self._lk_probes
         is_pair = self.pair
         ptail = (2,) if is_pair else ()   # trailing (re, im) axis in pair mode
-
-        def shard_body(x, alphas, norms, tables, lk_pair, lk_dir):
-            x, alphas, norms = x[0], alphas[0], norms[0]
-            lk_pair, lk_dir = lk_pair[0], lk_dir[0]
-            # pad local arrays to a whole number of chunks
-            xp = jnp.pad(x, ((0, Mp - M),) + ((0, 0),) * (x.ndim - 1))
-            ap = jnp.pad(alphas, (0, Mp - M),
-                         constant_values=SENTINEL_STATE)
-            np_ = jnp.pad(norms, (0, Mp - M), constant_values=1.0)
-
-            def chunk(carry, args):
-                y, overflow, invalid = carry
-                a_c, n_c, x_c = args
-                betas, gcoeff = K.gather_coefficients(tables, a_c, n_c)
-                # scatter-form amplitude: conj(row form) · x[α].  Liveness is
-                # *structural* (coeff ≠ 0, row not padding) — independent of
-                # x's zero pattern, so the overflow/invalid counters checked
-                # on the first call hold for every later x.
-                valid_row = (a_c != SENTINEL_STATE)[:, None]
-                if is_pair:
-                    nz = (gcoeff != 0).any(axis=-1) & valid_row
-                    amps = jnp.where(
-                        nz[..., None],
-                        K.cmul_pair(K.conj_pair(gcoeff), x_c[:, None, :]), 0)
-                else:
-                    nz = (gcoeff != 0) & valid_row
-                    amps = jnp.where(nz, jnp.conj(gcoeff) * x_c[:, None], 0)
-                flat_b = betas.reshape(-1)
-                flat_a = amps.reshape((-1,) + ptail)
-                live = nz.reshape(-1)
-                owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
-                    if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
-                key = jnp.where(live, owner, D)
-                order = jnp.argsort(key, stable=True)
-                key_s = key[order]
-                b_s = flat_b[order]
-                a_s = flat_a[order]
-                starts = jnp.searchsorted(key_s, jnp.arange(D + 1))
-                pos = jnp.arange(key_s.shape[0]) - starts[jnp.clip(key_s, 0, D)]
-                in_cap = (pos < Cap) & (key_s < D)
-                overflow = overflow + jnp.sum((pos >= Cap) & (key_s < D))
-                dest = jnp.where(in_cap, key_s * Cap + pos, D * Cap)
-                send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
-                    b_s, mode="drop")
-                send_a = jnp.zeros((D * Cap,) + ptail, dtype).at[dest].set(
-                    a_s, mode="drop")
-                if D > 1:
-                    recv_b = jax.lax.all_to_all(
-                        send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
-                    ).reshape(-1)
-                    recv_a = jax.lax.all_to_all(
-                        send_a.reshape((D, Cap) + ptail), SHARD_AXIS, 0, 0,
-                        tiled=True
-                    ).reshape((-1,) + ptail)
-                else:
-                    recv_b, recv_a = send_b, send_a
-                idx, found = state_index_bucketed(
-                    lk_pair, lk_dir, recv_b,
-                    shift=lk_shift, probes=lk_probes)
-                # structural liveness on the receive side: real entries carry
-                # a non-SENTINEL state (padding slots are SENTINEL, amp 0)
-                live_r = recv_b != SENTINEL_STATE
-                okc = found & live_r
-                invalid = invalid + jnp.sum(live_r & ~found)
-                y = y + jax.ops.segment_sum(
-                    jnp.where(okc[..., None] if is_pair else okc, recv_a, 0),
-                    jnp.where(okc, idx, 0),
-                    num_segments=M)
-                return (y, overflow, invalid), None
-
-            init = jax.lax.pcast(
-                (jnp.zeros((M,) + ptail, dtype), jnp.zeros((), jnp.int64),
-                 jnp.zeros((), jnp.int64)),
-                SHARD_AXIS, to="varying",
-            )
-            (y, overflow, invalid), _ = jax.lax.scan(
-                chunk, init,
-                (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
-                 xp.reshape((nchunks, B) + ptail).astype(dtype)),
-            )
-            # cross-shard totals so every shard reports the same counters
-            overflow = jax.lax.psum(overflow, SHARD_AXIS)
-            invalid = jax.lax.psum(invalid, SHARD_AXIS)
-            return y[None], overflow[None], invalid[None]
-
         mesh = self.mesh
 
-        def apply_fn(x, operands):
-            alphas, norms, diag, tables, lk_pair, lk_dir = operands
-            f = jax.shard_map(
-                shard_body, mesh=mesh,
-                in_specs=(_pspec(x.ndim), _pspec(2), _pspec(2), P(),
-                          _pspec(3), _pspec(2)),
-                out_specs=(_pspec(x.ndim), _pspec(1), _pspec(1)),
-            )
-            y, overflow, invalid = f(x.astype(dtype), alphas, norms, tables,
-                                     lk_pair, lk_dir)
-            d = diag.astype(dtype)
-            y = y + d.reshape(d.shape + (1,) * (x.ndim - 2)) * x.astype(dtype)
-            return y, overflow[0], invalid[0]
+        def make_program(B, Cap):
+            nchunks = M // B if M % B == 0 else M // B + 1
+            Mp = nchunks * B
 
+            def shard_body(x, alphas, norms, tables, lk_pair, lk_dir):
+                x, alphas, norms = x[0], alphas[0], norms[0]
+                lk_pair, lk_dir = lk_pair[0], lk_dir[0]
+                # an optional trailing batch axis rides the SAME routing: betas,
+                # owners, sort order, and the state-index lookup are per (row,
+                # term) — independent of the column — so a [M, k] batch pays one
+                # hash/argsort/all_to_all for all k columns instead of k full
+                # applies (the batch economics ELL mode already had)
+                tail = x.shape[1:]           # (k,)? + (2,)? — batch then pair
+                # pad local arrays to a whole number of chunks
+                xp = jnp.pad(x, ((0, Mp - M),) + ((0, 0),) * (x.ndim - 1))
+                ap = jnp.pad(alphas, (0, Mp - M),
+                             constant_values=SENTINEL_STATE)
+                np_ = jnp.pad(norms, (0, Mp - M), constant_values=1.0)
+                nbt = len(tail) - len(ptail)  # number of batch axes (0 or 1)
+
+                def chunk(carry, args):
+                    y, overflow, invalid = carry
+                    a_c, n_c, x_c = args
+                    betas, gcoeff = K.gather_coefficients(tables, a_c, n_c)
+                    # scatter-form amplitude: conj(row form) · x[α].  Liveness is
+                    # *structural* (coeff ≠ 0, row not padding) — independent of
+                    # x's zero pattern, so the overflow/invalid counters checked
+                    # on the first call hold for every later x.
+                    valid_row = (a_c != SENTINEL_STATE)[:, None]
+                    x_t = x_c[:, None]                      # [B, 1] + tail
+                    if is_pair:
+                        nz = (gcoeff != 0).any(axis=-1) & valid_row
+                        g_t = K.conj_pair(gcoeff)           # [B, T, 2]
+                        if nbt:
+                            g_t = g_t[:, :, None, :]        # [B, T, 1, 2]
+                        amps = jnp.where(
+                            nz.reshape(nz.shape + (1,) * len(tail)),
+                            K.cmul_pair(g_t, x_t), 0)
+                    else:
+                        nz = (gcoeff != 0) & valid_row
+                        g_t = jnp.conj(gcoeff)
+                        if nbt:
+                            g_t = g_t[:, :, None]
+                        amps = jnp.where(
+                            nz.reshape(nz.shape + (1,) * nbt), g_t * x_t, 0)
+                    flat_b = betas.reshape(-1)
+                    flat_a = amps.reshape((-1,) + tail)
+                    live = nz.reshape(-1)
+                    owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
+                        if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
+                    key = jnp.where(live, owner, D)
+                    order = jnp.argsort(key, stable=True)
+                    key_s = key[order]
+                    b_s = flat_b[order]
+                    a_s = flat_a[order]
+                    starts = jnp.searchsorted(key_s, jnp.arange(D + 1))
+                    pos = jnp.arange(key_s.shape[0]) - starts[jnp.clip(key_s, 0, D)]
+                    in_cap = (pos < Cap) & (key_s < D)
+                    overflow = overflow + jnp.sum((pos >= Cap) & (key_s < D))
+                    dest = jnp.where(in_cap, key_s * Cap + pos, D * Cap)
+                    send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
+                        b_s, mode="drop")
+                    send_a = jnp.zeros((D * Cap,) + tail, dtype).at[dest].set(
+                        a_s, mode="drop")
+                    if D > 1:
+                        recv_b = jax.lax.all_to_all(
+                            send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
+                        ).reshape(-1)
+                        recv_a = jax.lax.all_to_all(
+                            send_a.reshape((D, Cap) + tail), SHARD_AXIS, 0, 0,
+                            tiled=True
+                        ).reshape((-1,) + tail)
+                    else:
+                        recv_b, recv_a = send_b, send_a
+                    idx, found = state_index_bucketed(
+                        lk_pair, lk_dir, recv_b,
+                        shift=lk_shift, probes=lk_probes)
+                    # structural liveness on the receive side: real entries carry
+                    # a non-SENTINEL state (padding slots are SENTINEL, amp 0)
+                    live_r = recv_b != SENTINEL_STATE
+                    okc = found & live_r
+                    invalid = invalid + jnp.sum(live_r & ~found)
+                    y = y + jax.ops.segment_sum(
+                        jnp.where(okc.reshape(okc.shape + (1,) * len(tail)),
+                                  recv_a, 0),
+                        jnp.where(okc, idx, 0),
+                        num_segments=M)
+                    return (y, overflow, invalid), None
+
+                init = jax.lax.pcast(
+                    (jnp.zeros((M,) + tail, dtype), jnp.zeros((), jnp.int64),
+                     jnp.zeros((), jnp.int64)),
+                    SHARD_AXIS, to="varying",
+                )
+                (y, overflow, invalid), _ = jax.lax.scan(
+                    chunk, init,
+                    (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
+                     xp.reshape((nchunks, B) + tail).astype(dtype)),
+                )
+                # cross-shard totals so every shard reports the same counters
+                overflow = jax.lax.psum(overflow, SHARD_AXIS)
+                invalid = jax.lax.psum(invalid, SHARD_AXIS)
+                return y[None], overflow[None], invalid[None]
+
+            def apply_fn(x, operands):
+                alphas, norms, diag, tables, lk_pair, lk_dir = operands
+                f = jax.shard_map(
+                    shard_body, mesh=mesh,
+                    in_specs=(_pspec(x.ndim), _pspec(2), _pspec(2), P(),
+                              _pspec(3), _pspec(2)),
+                    out_specs=(_pspec(x.ndim), _pspec(1), _pspec(1)),
+                )
+                y, overflow, invalid = f(x.astype(dtype), alphas, norms,
+                                         tables, lk_pair, lk_dir)
+                d = diag.astype(dtype)
+                y = y + d.reshape(d.shape + (1,) * (x.ndim - 2)) \
+                    * x.astype(dtype)
+                return y, overflow[0], invalid[0]
+
+            return apply_fn
+
+        base_B = self.batch_size
+        apply_fn = make_program(base_B, self._capacity)
         self._apply_fn = apply_fn
         self._operands = (self._alphas, self._norms, self._diag, self.tables,
                           self._lk_pair, self._lk_dir)
-        _mv = jax.jit(apply_fn)
-        nd_batched = 4 if is_pair else 3
+        programs = {base_B: jax.jit(apply_fn)}
 
         def run(x):
-            if x.ndim == nd_batched:
-                # batch: apply per column (fused mode favors memory over speed)
-                cols = [_mv(x[..., k, :] if is_pair else x[..., k],
-                            self._operands)
-                        for k in range(x.shape[-1 - len(ptail)])]
-                y = jnp.stack([c[0] for c in cols], axis=2)
-                overflow = sum(c[1] for c in cols)
-                invalid = sum(c[2] for c in cols)
-                return y, overflow, invalid
-            return _mv(x, self._operands)
+            # Batches ride the same program: the routing (hash/argsort/
+            # all_to_all index side) is shared across columns, so a
+            # k-column apply costs one exchange with k× payload instead of
+            # k full applies.  WIDE batches shrink the row chunk so the
+            # per-chunk working set (amps [B, T, k] + exchange buffers
+            # [2·D·Cap·k]) stays within ~4× a single apply's footprint —
+            # fused mode exists precisely for bases that crowd HBM.
+            tl = 1 if is_pair else 0
+            k = x.shape[2] if x.ndim == 3 + tl else 1
+            B = base_B if k <= 4 else min(
+                base_B, _round_up(max(8, (4 * base_B) // k), 8))
+            if B not in programs:
+                programs[B] = jax.jit(
+                    make_program(B, self._fused_capacity(B)))
+            return programs[B](x, self._operands)
 
         return run
 

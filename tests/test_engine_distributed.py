@@ -100,7 +100,7 @@ def test_distributed_matches_local_engine(mode, rng):
 
 
 @needs_8
-@pytest.mark.parametrize("mode", ["ell", "compact"])
+@pytest.mark.parametrize("mode", ["ell", "compact", "fused"])
 def test_distributed_batch(mode, rng):
     op = build_heisenberg(10, 5, None, ())
     op.basis.build()
@@ -112,6 +112,68 @@ def test_distributed_batch(mode, rng):
         np.testing.assert_allclose(
             Y[:, k], op.matvec_host(X[:, k]), atol=ATOL, rtol=RTOL
         )
+
+
+@needs_8
+def test_distributed_batch_fused_pair(rng):
+    """Fused batches must ride the pair (re, im) layout too: hashed
+    [D, M, k, 2] in one program."""
+    from distributed_matvec_tpu.utils.config import update_config
+
+    op = build_heisenberg(10, 5, None, [([*range(1, 10), 0], 1)])
+    op.basis.build()
+    assert not op.effective_is_real
+    n = op.basis.number_states
+    X = (rng.random((n, 3)) - 0.5) + 1j * (rng.random((n, 3)) - 0.5)
+    update_config(complex_pair="on")
+    try:
+        eng = DistributedEngine(op, n_devices=8, mode="fused")
+        assert eng.pair
+        Y = eng.matvec_global(X)
+    finally:
+        update_config(complex_pair="auto")
+    for k in range(3):
+        np.testing.assert_allclose(
+            Y[:, k], op.matvec_host(X[:, k]), atol=ATOL, rtol=RTOL
+        )
+
+
+@needs_8
+def test_distributed_batch_fused_economics(rng):
+    """A fused k=4 batch shares the routing (hash, sort, all_to_all index
+    side) across columns, so it must cost well under 4 single applies —
+    the gate is <= 1.5x one apply (generous vs the measured ~1.1x, to
+    absorb CPU timing noise)."""
+    import time
+
+    op = build_heisenberg(12, 6, None, ())
+    op.basis.build()
+    n = op.basis.number_states
+    eng = DistributedEngine(op, n_devices=8, mode="fused")
+    x1 = eng.to_hashed(rng.random(n) - 0.5)
+    x4 = eng.to_hashed(rng.random((n, 4)) - 0.5)
+    # warm both programs (compile + first-call counter check)
+    eng.matvec(x1).block_until_ready()
+    eng.matvec(x4).block_until_ready()
+
+    def best_of(f, reps=5):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    # re-measure up to 3 times: a wall-clock ratio on shared CI hardware
+    # can be skewed by a transient load spike, which retrying absorbs
+    # without weakening the gate itself
+    for attempt in range(3):
+        t1 = best_of(lambda: eng.matvec(x1, check=False).block_until_ready())
+        t4 = best_of(lambda: eng.matvec(x4, check=False).block_until_ready())
+        if t4 <= 1.5 * t1 + 1e-3:
+            break
+    else:
+        raise AssertionError((t4, t1))
 
 
 @needs_8
@@ -242,3 +304,56 @@ def test_distributed_structure_cache(mode, tmp_path, rng):
     np.testing.assert_array_equal(y1, e2.matvec_global(x))
     e3 = DistributedEngine(op, n_devices=2, mode=mode, structure_cache=cache)
     assert not e3.structure_restored
+
+
+@needs_8
+@pytest.mark.slow
+def test_plan_build_memory_bounded():
+    """The streaming plan build must never materialize the dense
+    [D, M, T] host arrays the old build used (~36 GB at chain_36_symm).
+    chain_24 (N=2.7M, T=24) as the tractable proxy: the dense build's
+    transients (owner/idx/coeff + the argsort copies of _split_tables)
+    exceed 3.5 GB here; the streaming build + jax runtime + final packed
+    structure measured 2.0 GB.  Bound 2.7 GB — fails if anyone
+    reintroduces a full-width host materialization, with headroom for
+    allocator noise."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import resource, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        import numpy as np
+        from distributed_matvec_tpu.models.basis import SpinBasis
+        from distributed_matvec_tpu.models.yaml_io import operator_from_dict
+        basis = SpinBasis(number_spins=24, hamming_weight=12)
+        basis.build()
+        op = operator_from_dict(
+            {"terms": [{"expression":
+                        "\\u03c3\\u02e3\\u2080 \\u03c3\\u02e3\\u2081 + "
+                        "\\u03c3\\u02b8\\u2080 \\u03c3\\u02b8\\u2081 + "
+                        "\\u03c3\\u1dbb\\u2080 \\u03c3\\u1dbb\\u2081",
+                        "sites": [[i, (i + 1) % 24] for i in range(24)]}]},
+            basis)
+        from distributed_matvec_tpu.parallel.distributed import (
+            DistributedEngine)
+        eng = DistributedEngine(op, n_devices=8, mode="ell")
+        x = np.random.default_rng(0).standard_normal(basis.number_states)
+        y = eng.matvec_global(x)
+        assert np.isfinite(y).all()
+        peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+        print("PEAK_MB", peak_mb)
+        sys.exit(0 if peak_mb < 2700 else 17)
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "true"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.join(os.path.dirname(__file__), os.pardir)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=540)
+    assert r.returncode == 0, (r.returncode, r.stdout[-500:], r.stderr[-800:])
