@@ -89,6 +89,10 @@ def main(argv=None):
                          process_id=args.process_id)
     if args.timings:
         update_config(display_timings=True)
+    import jax
+    # multi-controller: every rank computes, rank 0 owns the output file
+    # (the reference's locale-0 I/O role, MyHDF5.chpl:215-252)
+    rank0 = jax.process_index() == 0
     out = args.output or os.path.splitext(args.input)[0] + ".h5"
     timer = TreeTimer("diagonalize")
 
@@ -100,7 +104,10 @@ def main(argv=None):
         return 2
 
     with timer.scope("basis"):
-        restored = make_or_restore_representatives(cfg.basis, out)
+        # every rank restores from the same checkpoint (agreement even
+        # against a stale file); only rank 0 writes it
+        restored = make_or_restore_representatives(cfg.basis, out,
+                                                   save=rank0)
     n = cfg.basis.number_states
     print(f"basis: N={n} states "
           f"({'restored from' if restored else 'checkpointed to'} {out})")
@@ -122,18 +129,20 @@ def main(argv=None):
     with timer.scope("solve"), maybe_profile():
         t0 = time.perf_counter()
         if args.block:
-            if getattr(eng, "pair", False) and hasattr(eng, "from_hashed"):
-                print("--block (LOBPCG) does not support distributed "
-                      "pair-form complex sectors; use Lanczos (default)",
-                      file=sys.stderr)
+            if jax.process_count() > 1:
+                print("--block (LOBPCG) is single-controller; use Lanczos "
+                      "(default) for multi-process runs", file=sys.stderr)
                 return 2
             evals, evecs_cols, iters = lobpcg(
                 eng.matvec, n, k=args.num_evals, tol=args.tol,
                 max_iters=args.max_iters)
+            # lobpcg returns block-order columns for both engines; route
+            # the residual matvec through the block-facing entry point
+            mv_block = getattr(eng, "matvec_global", None) \
+                or (lambda v: np.asarray(eng.matvec(v)))
             evecs = [evecs_cols[:, i] for i in range(evecs_cols.shape[1])]
             residuals = np.array([
-                float(np.linalg.norm(np.asarray(eng.matvec(v))
-                                     - w * np.asarray(v)))
+                float(np.linalg.norm(mv_block(v) - w * np.asarray(v)))
                 for w, v in zip(evals, evecs)])
             niter = iters
         else:
@@ -158,9 +167,12 @@ def main(argv=None):
         hashed_ndim = 3 if is_pair else 2   # [D, M(, 2)] hashed layout
         rows = []
         for v in evecs[: args.num_evals]:
+            # hashed → block order for I/O BEFORE any host fetch: in a
+            # multi-controller run the hashed array spans other processes'
+            # devices and from_hashed allgathers it
+            if hasattr(eng, "from_hashed") and np.ndim(v) == hashed_ndim:
+                v = eng.from_hashed(v)
             v = np.asarray(v)
-            if hasattr(eng, "from_hashed") and v.ndim == hashed_ndim:
-                v = eng.from_hashed(v)   # hashed → block order for I/O
             if is_pair and not np.iscomplexobj(v):
                 # (re, im) pair → complex for I/O (LOBPCG already
                 # returns complex columns)
@@ -171,7 +183,9 @@ def main(argv=None):
         evec_rows = np.stack(rows)
 
     with timer.scope("save"):
-        save_eigen(out, np.asarray(evals), evec_rows, np.asarray(residuals))
+        if rank0:
+            save_eigen(out, np.asarray(evals), evec_rows,
+                       np.asarray(residuals))
 
     for i, (w, r) in enumerate(zip(np.atleast_1d(evals),
                                    np.atleast_1d(residuals))):
@@ -190,29 +204,51 @@ def main(argv=None):
         psi = evec_rows[0]
         xh_cache = {}
 
+        def obs_input(obs):
+            """psi in the form the observable's engine consumes.
+
+            A REAL-sector engine cannot carry a complex state — casting
+            would silently drop Im(psi) — but for real Hermitian O,
+            ψ†Oψ = Re†O·Re + Im†O·Im (the cross terms cancel), so complex
+            psi becomes the two-column real batch [Re, Im] and the batched
+            dot sums both columns.  A complex-sector engine gets psi
+            promoted to complex.
+            """
+            if obs.effective_is_real:
+                if np.iscomplexobj(psi):
+                    return np.stack([psi.real, psi.imag], axis=1)
+                return psi
+            return psi.astype(np.complex128)
+
         def expectation(obs):
+            p = obs_input(obs)
             if args.devices and args.devices > 1:
                 from distributed_matvec_tpu.parallel.distributed import (
                     DistributedEngine)
                 # share H's mesh and hash layout (pure functions of the
                 # basis + device count) and reuse the shuffled |psi> per
-                # pair-ness — only the fused kernel tables differ per
+                # engine form — only the fused kernel tables differ per
                 # observable
                 oeng = DistributedEngine(obs, mesh=eng.mesh, mode="fused",
                                          layout=eng.layout)
-                if oeng.pair not in xh_cache:
-                    xh_cache[oeng.pair] = oeng.to_hashed(psi)
-                xh = xh_cache[oeng.pair]
+                key = (oeng.pair, p.dtype.kind, p.ndim)
+                if key not in xh_cache:
+                    xh_cache[key] = oeng.to_hashed(p)
+                xh = xh_cache[key]
+                # a [Re, Im] batch's dot sums both columns — exactly the
+                # two needed terms
                 return float(np.real(complex(oeng.dot(xh, oeng.matvec(xh)))))
             from distributed_matvec_tpu.parallel.engine import LocalEngine
             oeng = LocalEngine(obs, mode="fused")
-            return float(np.real(np.vdot(psi, np.asarray(oeng.matvec(psi)))))
+            y = np.asarray(oeng.matvec(p))
+            return float(np.real(np.vdot(p, y)))
 
         with timer.scope("observables"):
             values = [(obs.name or f"observable_{k}", expectation(obs))
                       for k, obs in enumerate(cfg.observables)]
-        for name, val in save_observables(out, values).items():
-            print(f"  <{name}> = {val:.12f}")
+        if rank0:
+            for name, val in save_observables(out, values).items():
+                print(f"  <{name}> = {val:.12f}")
 
     timer.report()
     return 0

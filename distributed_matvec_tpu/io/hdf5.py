@@ -163,12 +163,16 @@ def load_basis(path: str) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
         return reps, norms
 
 
-def make_or_restore_representatives(basis, path: Optional[str]) -> bool:
+def make_or_restore_representatives(basis, path: Optional[str],
+                                    save: bool = True) -> bool:
     """Build the basis, restoring representatives from ``path`` when present
     (exact ``makeBasisStates`` semantics, Diagonalize.chpl:227-246).
 
     Returns True if restored from checkpoint, False if computed (and, when a
-    path is given, checkpointed)."""
+    path is given and ``save`` is True, checkpointed).  In a multi-process
+    run every rank should RESTORE from the same path (so all ranks agree on
+    the representative set even against a stale checkpoint) but only one
+    rank should ``save``."""
     if path is not None:
         got = load_basis(path)
         if got is not None:
@@ -176,7 +180,7 @@ def make_or_restore_representatives(basis, path: Optional[str]) -> bool:
             basis.unchecked_set_representatives(reps, norms)
             return True
     basis.build()
-    if path is not None:
+    if path is not None and save:
         save_basis(path, basis.representatives, basis.norms)
     return False
 

@@ -1000,6 +1000,14 @@ class DistributedEngine:
         return jax.device_put(jnp.asarray(xh), shard_spec(self.mesh, xh.ndim))
 
     def from_hashed(self, xh) -> np.ndarray:
+        if (isinstance(xh, jax.Array) and jax.process_count() > 1
+                and not xh.is_fully_addressable):
+            # multi-controller: the hashed array spans other processes'
+            # devices — allgather the global value (DCN) before the host
+            # unshuffle, the H2B role of arrFromHashedToBlock
+            # (HashedToBlock.chpl:67-153)
+            from jax.experimental import multihost_utils
+            xh = multihost_utils.process_allgather(xh, tiled=True)
         return self.layout.from_hashed(np.asarray(xh))
 
     def random_hashed(self, seed: int = 0):

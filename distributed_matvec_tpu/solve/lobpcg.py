@@ -7,6 +7,15 @@ Built on ``jax.experimental.sparse.linalg.lobpcg_standard``, which computes
 the *largest* eigenvalues of an SPD-ish operator — we flip the spectrum with
 ``σ·I − H`` (σ = a cheap upper bound via Gershgorin over the ELL tables is
 overkill; a power-iteration estimate of ‖H‖ suffices).
+
+For a :class:`~..parallel.distributed.DistributedEngine` the whole iteration
+runs in the engine's HASHED space: block columns are flattened ``[D·M(·2), m]``
+views of the hashed layout, every matvec is one sharded apply (one
+``all_to_all``), and the small dense algebra inside ``lobpcg_standard``
+operates on the sharded flats.  Pad slots start at zero (``to_hashed`` zero
+fills) and stay zero — H maps them to 0 and all LOBPCG updates are linear
+combinations — so the flat space behaves exactly like the n-dimensional
+physical space.
 """
 
 from __future__ import annotations
@@ -44,7 +53,10 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     """Lowest-``k`` eigenpairs via spectrum-flipped LOBPCG.
 
     Returns (eigenvalues [k] ascending, eigenvectors [n, k], iterations).
-    Requires a matvec that accepts rank-2 ``[n, k]`` blocks (both engines do).
+    ``matvec`` may be a LocalEngine's (rank-2 ``[n, k]`` blocks) or a
+    DistributedEngine's (hashed ``[D, M, k(, 2)]`` blocks — handled via the
+    flat hashed space, see module docstring); eigenvectors always come back
+    in block (global sorted) order.
 
     ``pair`` (auto-detected from a pair-mode engine) runs the realified
     operator on R^{2n}: each complex eigenvalue appears twice (along v and
@@ -63,80 +75,125 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     owner = getattr(matvec, "__self__", None)
     if pair is None:
         pair = bool(getattr(owner, "pair", False))
+    dist = owner is not None and hasattr(owner, "from_hashed")
+    if dist and jax.process_count() > 1:
+        raise ValueError(
+            "LOBPCG is single-controller (host-side QR and J-copy dedup "
+            "need the whole flat space addressable); use solve.lanczos "
+            "for multi-process runs"
+        )
 
-    def mv1(x):
+    def run_flipped(mv, dim_, U0):
+        """sigma estimate, spectrum-flipped lobpcg_standard, ascending
+        (evals, columns, iters) output: the scaffold every branch shares."""
+        sigma = _norm_estimate(mv, dim_)
+        U0q, _ = np.linalg.qr(U0)
+        theta, U, iters = lobpcg_standard(
+            lambda X: sigma * X - mv(X), jnp.asarray(U0q),
+            m=max_iters, tol=tol)
+        evals = sigma - np.asarray(theta)
+        order = np.argsort(evals)
+        return sigma, evals[order], np.asarray(U)[:, order], int(iters)
+
+    def raw_mv(x):
         y = matvec(x)
         return y[0] if isinstance(y, tuple) else y
 
+    if dist:
+        # ---- hashed flat space adapters --------------------------------
+        D, M = owner.n_devices, owner.shard_size
+        dim = D * M * (2 if pair else 1)
+
+        def to_flat(Xh):
+            Xh = jnp.asarray(Xh)
+            if pair:                           # [D, M, m, 2] → [2DM, m]
+                return jnp.moveaxis(Xh, 3, 2).reshape(D * M * 2, Xh.shape[2])
+            return Xh.reshape(D * M, Xh.shape[2])
+
+        def from_flat(U):
+            m = U.shape[1]
+            if pair:
+                return jnp.moveaxis(U.reshape(D, M, 2, m), 2, 3)
+            return U.reshape(D, M, m)
+
+        def mv_flat(U):
+            if U.ndim == 1:                    # norm-estimate probe
+                return mv_flat(U[:, None])[:, 0]
+            return to_flat(raw_mv(from_flat(U)))
+
+        def block_x0(m):
+            """Random block-order start (pads land zero via to_hashed);
+            warm-start columns are eigenvector guesses, capped at k."""
+            rng = np.random.default_rng(seed)
+            Xb = rng.standard_normal((n, m))
+            if pair:
+                Xb = Xb + 1j * rng.standard_normal((n, m))
+            if X0 is not None:
+                W = np.asarray(X0)
+                if W.ndim != 2 or W.shape[0] != n or W.shape[1] > k:
+                    raise ValueError(
+                        f"X0 must be [n, j] with j <= k={k}, got {W.shape}")
+                Xb = Xb.astype(np.result_type(Xb, W))
+                Xb[:, : W.shape[1]] = W
+            return np.asarray(to_flat(owner.to_hashed(Xb)))
+
+        def cols_to_block(U):
+            """Flat columns → block order; complex for pair engines."""
+            V = owner.from_hashed(from_flat(jnp.asarray(np.asarray(U))))
+            if pair:
+                return V[..., 0] + 1j * V[..., 1]       # [n, m] complex
+            return V                                    # [n, m]
+
     if not pair:
-        sigma = _norm_estimate(mv1, n)
-
-        def flipped(X):
-            return sigma * X - mv1(X)
-
+        if dist:
+            _, evals, U, iters = run_flipped(mv_flat, dim, block_x0(k))
+            return evals, cols_to_block(U), iters
         if X0 is None:
             X0 = np.random.default_rng(seed).standard_normal((n, k))
-        X0, _ = np.linalg.qr(X0)
-        theta, U, iters = lobpcg_standard(
-            flipped, jnp.asarray(X0), m=max_iters, tol=tol)
-        evals = sigma - np.asarray(theta)
-        order = np.argsort(evals)
-        return evals[order], np.asarray(U)[:, order], int(iters)
+        _, evals, U, iters = run_flipped(raw_mv, n, X0)
+        return evals, U, iters
 
-    # -- pair form: flat realified operator on R^{2n} -----------------------
-    if hasattr(owner, "from_hashed"):
-        raise ValueError(
-            "pair-mode LOBPCG supports local engines only (the realified "
-            "block is in flat block order, not the hashed [D, M, 2] layout "
-            "a DistributedEngine consumes); use solve.lanczos for "
-            "distributed complex sectors"
-        )
+    # -- pair form: flat realified operator ---------------------------------
     # 2k for the J-doubling plus 2 guard vectors: the tail of an LOBPCG
     # block converges last, and the k-th *distinct* eigenvalue sits at
     # block position 2k-1 without the guard.  jax's lobpcg_standard
-    # requires 5·block < dim, i.e. 5·(2k+2) < 2n here.
+    # requires 5·block < dim.
     kk = 2 * k + 2
-    if 5 * kk >= 2 * n:
+    dim2 = dim if dist else 2 * n
+    if 5 * kk >= dim2:
         raise ValueError(
-            f"pair-mode LOBPCG needs n > 5·(k+1) (jax lobpcg block bound on "
-            f"the realified R^{{2n}}); got n={n}, k={k} — reduce k or use "
-            "solve.lanczos"
+            f"pair-mode LOBPCG needs dim > 5·(2k+2) (jax lobpcg block bound "
+            f"on the realified R^{{2n}}); got n={n}, k={k} — reduce k or "
+            "use solve.lanczos"
         )
 
-    def mv_flat(U):
-        """[2n, m] f64 → engine pair batch [n, m, 2] → back."""
-        if U.ndim == 1:           # norm-estimate probe vector
-            return mv_flat(U[:, None])[:, 0]
-        m = U.shape[1]
-        X = jnp.transpose(U.reshape(n, 2, m), (0, 2, 1))
-        Y = mv1(X)
-        return jnp.transpose(Y, (0, 2, 1)).reshape(2 * n, m)
+    if dist:
+        sigma, evals, U, iters = run_flipped(mv_flat, dim, block_x0(kk))
+    else:
+        def mv_flat_local(U):
+            """[2n, m] f64 → engine pair batch [n, m, 2] → back."""
+            if U.ndim == 1:           # norm-estimate probe vector
+                return mv_flat_local(U[:, None])[:, 0]
+            m = U.shape[1]
+            X = jnp.transpose(U.reshape(n, 2, m), (0, 2, 1))
+            Y = raw_mv(X)
+            return jnp.transpose(Y, (0, 2, 1)).reshape(2 * n, m)
 
-    sigma = _norm_estimate(mv_flat, 2 * n)
-
-    def flipped(U):
-        return sigma * U - mv_flat(U)
-
-    rng = np.random.default_rng(seed)
-    U0 = rng.standard_normal((2 * n, kk))
-    if X0 is not None:
-        # warm start: complex [n, j] columns (j ≤ k) realified into the
-        # leading block columns; remaining columns stay random
-        X0 = np.asarray(X0)
-        if X0.ndim != 2 or X0.shape[0] != n or X0.shape[1] > k:
-            raise ValueError(
-                f"pair-mode X0 must be complex [n, j] with j <= k="
-                f"{k}, got shape {X0.shape}"
-            )
-        # realify in the (re, im)-interleaved row layout mv_flat uses
-        U0[:, : X0.shape[1]] = np.stack(
-            [X0.real, X0.imag], axis=1).reshape(2 * n, X0.shape[1])
-    U0, _ = np.linalg.qr(U0)
-    theta, U, iters = lobpcg_standard(
-        flipped, jnp.asarray(U0), m=max_iters, tol=tol)
-    evals = sigma - np.asarray(theta)
-    order = np.argsort(evals)
-    evals, U = evals[order], np.asarray(U)[:, order]
+        rng = np.random.default_rng(seed)
+        U0 = rng.standard_normal((2 * n, kk))
+        if X0 is not None:
+            # warm start: complex [n, j] columns (j ≤ k) realified into the
+            # leading block columns; remaining columns stay random
+            X0 = np.asarray(X0)
+            if X0.ndim != 2 or X0.shape[0] != n or X0.shape[1] > k:
+                raise ValueError(
+                    f"pair-mode X0 must be complex [n, j] with j <= k="
+                    f"{k}, got shape {X0.shape}"
+                )
+            # realify in the (re, im)-interleaved row layout mv_flat uses
+            U0[:, : X0.shape[1]] = np.stack(
+                [X0.real, X0.imag], axis=1).reshape(2 * n, X0.shape[1])
+        sigma, evals, U, iters = run_flipped(mv_flat_local, 2 * n, U0)
     # Complex view; keep one representative per complex direction.  Columns
     # are processed per eigenvalue *cluster*: each cluster block is first
     # projected against ALL previously kept vectors (so a J-copy whose
@@ -152,7 +209,10 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     # carries the eigenvalue of its own pivot column.
     from scipy.linalg import qr as _pivoted_qr
 
-    Z = U.reshape(n, 2, kk)[:, 0] + 1j * U.reshape(n, 2, kk)[:, 1]
+    if dist:
+        Z = cols_to_block(U)
+    else:
+        Z = U.reshape(n, 2, kk)[:, 0] + 1j * U.reshape(n, 2, kk)[:, 1]
     Z = Z / np.maximum(np.linalg.norm(Z, axis=0, keepdims=True), 1e-300)
     gap = cluster_rtol * max(abs(sigma), 1.0)
     kept_vals, kept_vecs = [], []

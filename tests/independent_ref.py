@@ -1,0 +1,65 @@
+"""Independent σ-Heisenberg ring reference — shares NOTHING with the package.
+
+The golden harness above 12 sites previously checked engine-vs-matvec_host,
+both of which consume ``models/expression.py``'s term tables; a bug in the
+term compiler would cancel out.  This module builds H·x from the textbook
+definition directly — pure NumPy bit operations, no expression parsing, no
+term tables, no hashing — the same independence role the reference's
+OpenMP-generated goldens play (SURVEY.md §4, input_for_matvec.py).
+
+Convention matches the package's YAML models: σ-form Pauli matrices (4× the
+spin-1/2 S-form), H = Σ_⟨ij⟩ σˣᵢσˣⱼ + σʸᵢσʸⱼ + σᶻᵢσᶻⱼ over ring bonds:
+  * σᶻᵢσᶻⱼ |s⟩ = ±|s⟩  (+ if bits i, j equal, − otherwise)
+  * (σˣᵢσˣⱼ + σʸᵢσʸⱼ) |s⟩ = 2·|s with bits i, j swapped⟩ if they differ,
+    else 0.
+"""
+
+from itertools import combinations
+
+import numpy as np
+
+
+def enumerate_fixed_hw(n: int, hw: int) -> np.ndarray:
+    """All n-bit states with ``hw`` bits set, ascending (independent of the
+    package's enumeration: itertools position sets, not bit tricks)."""
+    states = np.fromiter(
+        (sum(1 << p for p in pos) for pos in combinations(range(n), hw)),
+        dtype=np.uint64)
+    return np.sort(states)
+
+
+def heisenberg_ring_apply(states: np.ndarray, n: int,
+                          x: np.ndarray) -> np.ndarray:
+    """y = H·x on the fixed-hw sector spanned by sorted ``states``."""
+    y = np.zeros_like(x, dtype=np.float64)
+    s = states
+    for i in range(n):
+        j = (i + 1) % n
+        bi = (s >> np.uint64(i)) & np.uint64(1)
+        bj = (s >> np.uint64(j)) & np.uint64(1)
+        differ = bi != bj
+        # σᶻσᶻ: diagonal ±1 per bond
+        y += np.where(differ, -1.0, 1.0) * x
+        # σˣσˣ + σʸσʸ: amplitude 2 to the spin-swapped state
+        flip = s[differ] ^ np.uint64((1 << i) | (1 << j))
+        idx = np.searchsorted(s, flip)
+        assert (s[idx] == flip).all(), "flipped state left the sector"
+        np.add.at(y, idx, 2.0 * x[differ])
+    return y
+
+
+def ring_ground_energy(n: int, hw: int, tol: float = 1e-12):
+    """Lowest eigenvalue of the full fixed-hw sector via ARPACK over the
+    independent apply (the ground state of the bipartite ring lives in the
+    fully symmetric momentum/parity/inversion sector, so this also pins the
+    *_symm configs' E0)."""
+    from scipy.sparse.linalg import LinearOperator, eigsh
+
+    states = enumerate_fixed_hw(n, hw)
+    N = states.size
+    op = LinearOperator(
+        (N, N), matvec=lambda v: heisenberg_ring_apply(states, n, v),
+        dtype=np.float64)
+    w = eigsh(op, k=1, which="SA", tol=tol,
+              return_eigenvectors=False)
+    return float(w[0]), states

@@ -1,0 +1,67 @@
+"""Worker for the 2-process multi-controller test (spawned by
+``test_multihost_two_process``).
+
+The multi-host analog of the reference's GASNet-substrate cluster runs
+(env/chpl-env-*.sh + SPMD per-locale setup, Diagonalize.chpl:298-325):
+``jax.distributed`` over two processes, each owning 4 CPU devices of a
+global 8-device mesh.  Every engine mode builds its structures from
+process-addressable shards only; matvec + Lanczos must agree with the
+single-process truth.
+
+Usage: multihost_worker.py <pid> <nproc> <port>
+"""
+
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from distributed_matvec_tpu.parallel.mesh import init_distributed
+
+init_distributed(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=nproc, process_id=pid)
+
+import numpy as np
+
+assert len(jax.devices()) == 4 * nproc, jax.devices()
+assert jax.process_count() == nproc
+
+from distributed_matvec_tpu.models.basis import SpinBasis
+from distributed_matvec_tpu.models.yaml_io import operator_from_dict
+from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+from distributed_matvec_tpu.solve import lanczos
+
+N_SPINS = 12
+E0_OVER_4 = -5.3873909174          # exact 12-site ring ground state / 4
+
+basis = SpinBasis(number_spins=N_SPINS, hamming_weight=N_SPINS // 2)
+basis.build()
+op = operator_from_dict({"terms": [{
+    "expression": "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁",
+    "sites": [[i, (i + 1) % N_SPINS] for i in range(N_SPINS)]}]}, basis)
+
+x = np.random.default_rng(7).standard_normal(basis.number_states)
+want = op.matvec_host(x)
+
+for mode in ("ell", "compact", "fused"):
+    eng = DistributedEngine(op, n_devices=4 * nproc, mode=mode)
+    y = eng.from_hashed(eng.matvec(eng.to_hashed(x)))
+    err = float(np.abs(y - want).max())
+    print(f"[p{pid}] {mode}: matvec max err {err:.3e}", flush=True)
+    assert err < 1e-12, (mode, err)
+
+res = lanczos(eng.matvec, v0=eng.random_hashed(seed=3), k=1, tol=1e-9)
+e0 = float(res.eigenvalues[0])
+print(f"[p{pid}] lanczos E0/4 = {e0 / 4:.10f}", flush=True)
+assert abs(e0 / 4 - E0_OVER_4) < 1e-7
+
+print(f"[p{pid}] MULTIHOST_OK", flush=True)

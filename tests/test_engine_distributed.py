@@ -357,3 +357,39 @@ def test_plan_build_memory_bounded():
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, env=env, timeout=540)
     assert r.returncode == 0, (r.returncode, r.stdout[-500:], r.stderr[-800:])
+
+
+@pytest.mark.slow
+def test_multihost_two_process():
+    """A REAL multi-controller run: 2 jax.distributed processes, 4 CPU
+    devices each, one 8-device mesh — the DCN analog of the reference's
+    GASNet substrates (env/chpl-env-*.sh).  Each process packs only its
+    addressable plan shards; all three engine modes matvec + a Lanczos
+    block against single-process truth (multihost_worker.py)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    with socket.socket() as s:              # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]

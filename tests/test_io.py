@@ -218,3 +218,38 @@ observables:
     want = float(np.vdot(psi, cfg.observables[0].matvec_host(psi)).real)
     assert abs(corr - want) < 1e-10, (corr, want)
     assert abs(corr - w[0] / 10) < 1e-6
+
+
+@pytest.mark.slow
+def test_diagonalize_cli_multihost(tmp_path):
+    """--coordinator/--num-processes drive a REAL 2-process multi-controller
+    run of the driver (4 CPU devices per process, one 8-device mesh); rank 0
+    owns the output file.  Exercises the path the flags exist for."""
+    import socket
+    import subprocess
+    import sys
+
+    yaml_path = _write_ring_yaml(tmp_path)
+    out = str(tmp_path / "m.h5")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = _cli_env(XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    procs = [subprocess.Popen(
+        [sys.executable, _APP, yaml_path, "-o", out, "-k", "1",
+         "--devices", "8",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--num-processes", "2", "--process-id", str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid}:\n{o[-2000:]}"
+    w, V, res = load_eigen(out)
+    assert abs(w[0] - _RING10_E0) < 1e-7
+    assert res[0] < 1e-8

@@ -229,3 +229,50 @@ def test_diagonalize_cli_pair(tmp_path, pair_mode):
         evecs = f["hamiltonian/eigenvectors"][...]
     np.testing.assert_allclose(evals, w[:2], atol=1e-9)
     assert np.iscomplexobj(evecs)
+
+
+def test_diagonalize_cli_observables_complex_psi(tmp_path, pair_mode):
+    """A REAL observable on a COMPLEX momentum-sector ground state: the
+    driver must compute psi^dagger O psi (via the [Re, Im] two-column
+    batch), not (Re psi)^T O (Re psi) — the silent-truncation regression."""
+    import h5py
+    import yaml
+
+    cfg = {
+        "basis": {"number_spins": 10, "hamming_weight": 5,
+                  "symmetries": [
+                      {"permutation": [1, 2, 3, 4, 5, 6, 7, 8, 9, 0],
+                       "sector": 1}]},
+        "hamiltonian": {"name": "H", "terms": [
+            {"expression": "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁",
+             "sites": [[i, (i + 1) % 10] for i in range(10)]},
+        ]},
+        "observables": [
+            {"name": "nn_corr",
+             "terms": [{"expression": "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁",
+                        "sites": [[0, 1]]}]},
+        ],
+    }
+    yml = tmp_path / "momentum_obs.yaml"
+    yml.write_text(yaml.dump(cfg))
+    out = tmp_path / "momentum_obs.h5"
+
+    import sys
+    sys.path.insert(0, "apps")
+    import diagonalize
+    rc = diagonalize.main([str(yml), "-o", str(out), "-k", "1",
+                           "--tol", "1e-10", "--observables"])
+    assert rc == 0
+
+    from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+    c = load_config_from_yaml(str(yml), observables=True)
+    c.basis.build()
+    with h5py.File(out, "r") as f:
+        psi = f["hamiltonian/eigenvectors"][0]
+        got = float(f["observables/nn_corr"][()])
+    assert np.iscomplexobj(psi) and np.abs(psi.imag).max() > 1e-3
+    want = float(np.vdot(psi, c.observables[0].matvec_host(psi)).real)
+    assert abs(got - want) < 1e-10, (got, want)
+    # the truncated value would differ measurably
+    wrong = float(psi.real @ c.observables[0].matvec_host(psi.real).real)
+    assert abs(got - wrong) > 1e-6, "test is vacuous: Re-only equals full"

@@ -38,10 +38,12 @@ SMALL = [  # dense-verified
 ]
 MEDIUM = [  # engine vs host matvec
     "heisenberg_chain_16.yaml",
+    "heisenberg_chain_20.yaml",
     "heisenberg_square_4x4.yaml",
     "heisenberg_kagome_16.yaml",
 ]
-LARGE = [  # symmetry-projected, native enumeration
+LARGE = [  # symmetry-projected or multi-million-state, slow-marked
+    "heisenberg_chain_24.yaml",
     "heisenberg_chain_24_symm.yaml",
 ]
 
@@ -137,3 +139,33 @@ def test_full_yaml_matrix_loads():
         assert cfg.basis.number_spins >= 4
         assert cfg.hamiltonian is not None
         assert cfg.hamiltonian.number_off_diag_terms > 0
+
+
+@require_data
+@pytest.mark.slow
+def test_square_5x5_engine_vs_host(rng):
+    """square_5x5 (N=5.2M, 50 bonds) — the largest config whose host
+    matvec is still test-tractable; with this the automated matrix covers
+    every `make check` config (Makefile:111-125) plus two sizes beyond."""
+    cfg = _load("heisenberg_square_5x5.yaml")
+    x = _random_x(cfg, rng)
+    eng = LocalEngine(cfg.hamiltonian)
+    np.testing.assert_allclose(
+        np.asarray(eng.matvec(x)), cfg.hamiltonian.matvec_host(x),
+        atol=ATOL, rtol=RTOL)
+
+
+@require_data
+@pytest.mark.slow
+def test_chain_28_fused_vs_independent(rng):
+    """chain_28 (N=40.1M) — fused (recompute-on-the-fly) engine against
+    the term-compiler-independent bit-op apply; host matvec_host is too
+    slow at this size, the independent ring apply is not."""
+    from independent_ref import heisenberg_ring_apply
+
+    cfg = _load("heisenberg_chain_28.yaml")
+    x = _random_x(cfg, rng)
+    eng = LocalEngine(cfg.hamiltonian, mode="fused")
+    y_ref = heisenberg_ring_apply(cfg.basis.representatives, 28, x)
+    np.testing.assert_allclose(
+        np.asarray(eng.matvec(x)), y_ref, atol=ATOL, rtol=RTOL)
