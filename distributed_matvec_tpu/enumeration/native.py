@@ -132,22 +132,19 @@ def _ranges(lo: int, hi: int, hamming: Optional[int], n_chunks: int):
     return (np.array(starts, dtype=np.uint64), np.array(ends, dtype=np.uint64))
 
 
-def enumerate_representatives_native(
+def _stream_native(
+    lib,
     n_sites: int,
     hamming_weight: Optional[int],
     group,
     n_chunks: Optional[int] = None,
     n_threads: Optional[int] = None,
     norm_tol: float = 1e-12,
-) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Streaming native enumeration; None if the kernel is unavailable.
-
-    Matches :func:`host.enumerate_representatives` exactly (same order,
-    same norms) — property-tested in tests/test_enumeration.py.
-    """
-    lib = _load()
-    if lib is None:
-        return None
+):
+    """Generator over (states, norms) survivor slabs in ascending state
+    order — the chunk ranges are disjoint and ascending, so concatenating
+    the slabs (or routing them anywhere) preserves global sortedness.
+    Memory is bounded by one task batch's buffers."""
     lo = (1 << hamming_weight) - 1 if hamming_weight else 0
     hi = (lo << (n_sites - hamming_weight)) if hamming_weight \
         else (1 << n_sites) - 1
@@ -175,12 +172,10 @@ def enumerate_representatives_native(
     starts, ends = _ranges(lo, hi, hamming_weight, n_chunks)
     ntasks = starts.size
     if ntasks == 0:
-        return (np.empty(0, np.uint64), np.empty(0, np.float64))
+        return
 
     # Survivor capacity per task: candidates/G is the expectation; give 4×
     # headroom + constant. On overflow (-1) retry with the exact bound.
-    out_states_parts = []
-    out_norms_parts = []
     # process tasks in batches to bound memory
     batch = max(1, min(ntasks, 256))
     use_h = 1 if hamming_weight not in (None, 0) else 0
@@ -220,9 +215,32 @@ def enumerate_representatives_native(
             caps = spans  # exact upper bound — cannot overflow
         for t in range(nb):
             o, c = offsets[t], counts[t]
-            out_states_parts.append(buf_s[o:o + c].copy())
-            out_norms_parts.append(buf_n[o:o + c].copy())
+            if c:
+                yield buf_s[o:o + c].copy(), buf_n[o:o + c].copy()
     del keepalive
-    states = np.concatenate(out_states_parts)
-    norms = np.concatenate(out_norms_parts)
-    return states, norms
+
+
+def enumerate_representatives_native(
+    n_sites: int,
+    hamming_weight: Optional[int],
+    group,
+    n_chunks: Optional[int] = None,
+    n_threads: Optional[int] = None,
+    norm_tol: float = 1e-12,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Streaming native enumeration; None if the kernel is unavailable.
+
+    Matches :func:`host.enumerate_representatives` exactly (same order,
+    same norms) — property-tested in tests/test_enumeration.py.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    parts_s, parts_n = [], []
+    for s, n in _stream_native(lib, n_sites, hamming_weight, group,
+                               n_chunks, n_threads, norm_tol):
+        parts_s.append(s)
+        parts_n.append(n)
+    if not parts_s:
+        return (np.empty(0, np.uint64), np.empty(0, np.float64))
+    return np.concatenate(parts_s), np.concatenate(parts_n)

@@ -1,0 +1,203 @@
+"""Distributed-memory enumeration: representatives stream INTO shards.
+
+The reference enumerates representatives *into* distributed memory — per-chunk
+locale masks/counts, a count-matrix exchange, then a counting-sort scatter
+with one PUT per destination locale (StatesEnumeration.chpl:305-514); no node
+ever holds the global array.  This module is the single-host analog with the
+same memory property: the native enumeration kernel streams survivor slabs
+(bounded buffers), each slab is hash-routed to its owning shard
+(``localeIdxOf``, StatesEnumeration.chpl:129-136) and appended to that
+shard's on-disk dataset.  Peak memory is one slab + the append buffers —
+never the global representative array — which is what makes the ≥10⁹-state
+regime (README.md:69-116) reachable: chain_40_symm's 862M representatives
+(13.8 GB of state+norm data) spill to disk while the Python process stays
+flat.
+
+Because the enumeration ranges are disjoint and ascending, each shard's
+dataset is automatically SORTED — exactly the per-shard order
+:class:`~..parallel.shuffle.HashedLayout` produces, so the shards can feed a
+:class:`~..parallel.distributed.DistributedEngine` directly.
+
+The shard file doubles as a checkpoint (the ``makeBasisStates`` restore
+semantics, Diagonalize.chpl:227-246, one level down): re-running with the
+same parameters restores instead of re-enumerating.  Totals are validated
+against :meth:`SymmetryGroup.sector_dimension_census` — a pure-combinatorics
+count (projector trace over the fixed-hamming space) sharing nothing with
+the enumeration kernels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from . import native as _native
+from .host import shard_index
+from ..utils.logging import log_debug
+
+__all__ = ["enumerate_to_shards", "load_shard", "shard_manifest"]
+
+_CHUNK = 1 << 20     # h5py append granularity (8 MB of u64)
+
+
+def _fingerprint(n_sites, hamming_weight, group, n_shards,
+                 norm_tol) -> str:
+    h = hashlib.sha256()
+    h.update(json.dumps(
+        [n_sites, hamming_weight, n_shards, float(norm_tol)]).encode())
+    for p in group.perms:
+        h.update(np.asarray(p.perm, np.int64).tobytes())
+    h.update(np.ascontiguousarray(group.characters).tobytes())
+    h.update(np.ascontiguousarray(group.flip).tobytes())
+    return h.hexdigest()
+
+
+def enumerate_to_shards(
+    n_sites: int,
+    hamming_weight: Optional[int],
+    group,
+    n_shards: int,
+    path: str,
+    norm_tol: float = 1e-12,
+    n_chunks: Optional[int] = None,
+    n_threads: Optional[int] = None,
+    census_check: bool = True,
+    flush_elems: int = 4 << 20,
+) -> dict:
+    """Enumerate representatives of the sector straight into per-shard
+    datasets at ``path`` (HDF5).  Returns the manifest dict
+    ``{"counts": [D], "total": N, "restored": bool}``.
+
+    Requires the native kernel (the pure-NumPy fallback would make the
+    ≥10⁸-candidate configs this exists for intractable).
+    """
+    import h5py
+
+    fp = _fingerprint(n_sites, hamming_weight, group, n_shards, norm_tol)
+    if os.path.exists(path):
+        man = shard_manifest(path)
+        if man is not None and man.get("fingerprint") == fp:
+            log_debug(f"sharded enumeration restored from {path}")
+            man["restored"] = True
+            return man
+        # stale checkpoint: leave it in place until the fresh enumeration
+        # SUCCEEDS — os.replace below swaps it atomically, so a crash
+        # mid-run preserves the previous (still self-consistent) file
+
+    lib = _native._load()
+    if lib is None:
+        raise RuntimeError(
+            "sharded enumeration needs the native kernel (g++); "
+            "it is not available on this host"
+        )
+
+    D = n_shards
+    counts = np.zeros(D, dtype=np.int64)
+    pend_s = [[] for _ in range(D)]
+    pend_n = [[] for _ in range(D)]
+    pending = np.zeros(D, dtype=np.int64)
+
+    tmp = path + ".tmp"
+    with h5py.File(tmp, "w") as f:
+        g = f.create_group("shards")
+        dsets = []
+        for d in range(D):
+            gd = g.create_group(str(d))
+            dsets.append((
+                gd.create_dataset("representatives", shape=(0,),
+                                  maxshape=(None,), dtype=np.uint64,
+                                  chunks=(_CHUNK,)),
+                gd.create_dataset("norms", shape=(0,), maxshape=(None,),
+                                  dtype=np.float64, chunks=(_CHUNK,)),
+            ))
+
+        def flush(d):
+            if not pending[d]:
+                return
+            s = np.concatenate(pend_s[d])
+            nn = np.concatenate(pend_n[d])
+            ds, dn = dsets[d]
+            o = ds.shape[0]
+            ds.resize((o + s.size,))
+            dn.resize((o + s.size,))
+            ds[o:] = s
+            dn[o:] = nn
+            pend_s[d].clear()
+            pend_n[d].clear()
+            pending[d] = 0
+
+        done = 0
+        for slab_s, slab_n in _native._stream_native(
+                lib, n_sites, hamming_weight, group,
+                n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol):
+            owner = shard_index(slab_s, D)
+            for d in range(D):
+                sel = owner == d
+                c = int(sel.sum())
+                if not c:
+                    continue
+                pend_s[d].append(slab_s[sel])
+                pend_n[d].append(slab_n[sel])
+                pending[d] += c
+                counts[d] += c
+                if pending[d] >= flush_elems:
+                    flush(d)
+            done += slab_s.size
+            log_debug(f"sharded enumeration: {done} representatives routed")
+        for d in range(D):
+            flush(d)
+
+        total = int(counts.sum())
+        if census_check:
+            want = group.sector_dimension_census(hamming_weight)
+            if total != want:
+                raise RuntimeError(
+                    f"sharded enumeration found {total} representatives but "
+                    f"the sector-dimension census says {want} — enumeration "
+                    "and combinatorics disagree"
+                )
+        f.attrs["n_shards"] = D
+        f.attrs["counts"] = counts
+        f.attrs["total"] = total
+        f.attrs["n_sites"] = n_sites
+        f.attrs["hamming_weight"] = -1 if hamming_weight is None \
+            else int(hamming_weight)
+        # fingerprint LAST (same crash-consistency convention as the
+        # engine-structure sidecars)
+        f.attrs["fingerprint"] = fp
+    os.replace(tmp, path)
+    log_debug(f"sharded enumeration: {total} representatives in {D} shards "
+              f"at {path}")
+    return {"counts": counts.tolist(), "total": total, "fingerprint": fp,
+            "restored": False}
+
+
+def shard_manifest(path: str) -> Optional[dict]:
+    """Counts/total/fingerprint of a shard file, or None if unreadable."""
+    import h5py
+
+    try:
+        with h5py.File(path, "r") as f:
+            if "fingerprint" not in f.attrs:
+                return None
+            return {"counts": list(map(int, f.attrs["counts"])),
+                    "total": int(f.attrs["total"]),
+                    "n_shards": int(f.attrs["n_shards"]),
+                    "fingerprint": str(f.attrs["fingerprint"]),
+                    "restored": True}
+    except OSError:
+        return None
+
+
+def load_shard(path: str, d: int):
+    """(representatives, norms) of one shard — sorted ascending; only this
+    shard's data is read into memory."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        g = f["shards"][str(d)]
+        return g["representatives"][...], g["norms"][...]

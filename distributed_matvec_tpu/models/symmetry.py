@@ -342,6 +342,83 @@ class SymmetryGroup:
         reps, _, norms = self.state_info(states)
         return (reps == np.asarray(states, dtype=np.uint64)) & (norms > 0), norms
 
+    def sector_dimension_census(self, hamming_weight: Optional[int]) -> int:
+        """Representative count by pure combinatorics — NO enumeration.
+
+        dim = (1/|G|) Σ_g χ*(g) · |Fix_hw(g)| (trace of the sector
+        projector over the fixed-hamming space).  |Fix| of an element
+        (π, flip) comes from its cycle structure: walking a cycle, the bit
+        pattern is determined by the start bit and the cumulative flip;
+        a cycle with odd total flip admits no fixed string, otherwise it
+        contributes ``x^c + x^(L−c)`` ones (c = positions with cumulative
+        flip 1), combined by a small knapsack over cycles.  This is the
+        independent census the sharded enumeration is validated against —
+        the fixed-hamming analog of ``determineEnumerationRanges``'s
+        rank/unrank space accounting (StatesEnumeration.chpl:77-113).
+        """
+        n = self.n_sites
+        if hamming_weight is None:
+            # free space: |Fix| = 2^(#cycles with even flip) or 0
+            total = 0.0 + 0.0j
+            for g, p in enumerate(self.perms):
+                cnt = 1
+                for _, flips in _cycles_with_flip(p, bool(self.flip[g])):
+                    if sum(flips) % 2:
+                        cnt = 0
+                        break
+                    cnt *= 2
+                total += np.conj(self.characters[g]) * cnt
+            dim = total.real / len(self.perms)
+            return int(round(dim))
+        total = 0.0 + 0.0j
+        for g, p in enumerate(self.perms):
+            poly = np.zeros(hamming_weight + 1)
+            poly[0] = 1.0
+            dead = False
+            for cyc, flips in _cycles_with_flip(p, bool(self.flip[g])):
+                if sum(flips) % 2:
+                    dead = True
+                    break
+                L = len(cyc)
+                # ones when the start bit is 0: positions whose cumulative
+                # flip (before entering the position) is 1
+                c = 0
+                acc = 0
+                for f in flips[:-1]:
+                    acc ^= f
+                    c += acc
+                new = np.zeros_like(poly)
+                # both start bits, even when they give the same ones-count
+                # (flip cycles with c = L/2 contribute 2·x^(L/2))
+                for ones in (c, L - c):
+                    if ones <= hamming_weight:
+                        new[ones:] += poly[: poly.size - ones]
+                poly = new
+            if not dead:
+                total += np.conj(self.characters[g]) * poly[hamming_weight]
+        dim = total.real / len(self.perms)
+        return int(round(dim))
+
+
+def _cycles_with_flip(p: Permutation, flip: bool):
+    """Cycles of ``p`` with per-step flip bits (global spin inversion flips
+    at every step; plain permutations never do)."""
+    n = len(p.perm)
+    seen = [False] * n
+    out = []
+    step = 1 if flip else 0
+    for i in range(n):
+        if seen[i]:
+            continue
+        cyc = []
+        j = i
+        while not seen[j]:
+            seen[j] = True
+            cyc.append(j)
+            j = p.perm[j]
+        out.append((cyc, [step] * len(cyc)))
+    return out
+
 
 def trivial_group(n_sites: int) -> SymmetryGroup:
     return SymmetryGroup.build(n_sites)
