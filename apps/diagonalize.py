@@ -60,11 +60,19 @@ def main(argv=None):
                     help="multi-host: total process count")
     ap.add_argument("--process-id", type=int, default=None,
                     help="multi-host: this process's rank")
+    ap.add_argument("--shards", default=None, metavar="SHARDS_H5",
+                    help="construct the engine from a sharded-enumeration "
+                         "file (tools/sharded_enum_scale.py) — the global "
+                         "representative array is never built; implies a "
+                         "hashed-space solve (pair with --no-eigenvectors "
+                         "at large scale)")
     ap.add_argument("--mode", choices=("ell", "compact", "fused"),
-                    default="ell",
-                    help="engine mode: precomputed structure (ell), "
-                         "4 B/entry for isotropic real sectors (compact), "
-                         "or recompute-on-the-fly (fused)")
+                    default=None,
+                    help="engine mode: precomputed structure (ell, the "
+                         "default), 4 B/entry for isotropic real sectors "
+                         "(compact), or recompute-on-the-fly (fused — the "
+                         "default with --shards, where a plan build would "
+                         "re-materialize the global arrays)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--no-eigenvectors", action="store_true",
@@ -74,6 +82,8 @@ def main(argv=None):
     ap.add_argument("--timings", action="store_true",
                     help="print phase timings (kDisplayTimings)")
     args = ap.parse_args(argv)
+    if args.mode is None:
+        args.mode = "fused" if args.shards else "ell"
 
     from distributed_matvec_tpu.io import (
         make_or_restore_representatives, save_eigen)
@@ -103,17 +113,30 @@ def main(argv=None):
         print("config has no hamiltonian section", file=sys.stderr)
         return 2
 
-    with timer.scope("basis"):
-        # every rank restores from the same checkpoint (agreement even
-        # against a stale file); only rank 0 writes it
-        restored = make_or_restore_representatives(cfg.basis, out,
-                                                   save=rank0)
-    n = cfg.basis.number_states
-    print(f"basis: N={n} states "
-          f"({'restored from' if restored else 'checkpointed to'} {out})")
+    if args.shards:
+        with timer.scope("engine"):
+            from distributed_matvec_tpu.parallel.distributed import (
+                DistributedEngine)
+            eng = DistributedEngine.from_shards(
+                cfg.hamiltonian, args.shards,
+                n_devices=args.devices or None, mode=args.mode)
+            v0 = eng.random_hashed(seed=42)
+        n = eng.n_states
+        print(f"basis: N={n} states (shard-native from {args.shards})")
+    else:
+        with timer.scope("basis"):
+            # every rank restores from the same checkpoint (agreement even
+            # against a stale file); only rank 0 writes it
+            restored = make_or_restore_representatives(cfg.basis, out,
+                                                       save=rank0)
+        n = cfg.basis.number_states
+        print(f"basis: N={n} states "
+              f"({'restored from' if restored else 'checkpointed to'} {out})")
 
     with timer.scope("engine"):
-        if args.devices and args.devices > 1:
+        if args.shards:
+            pass                              # engine built above
+        elif args.devices and args.devices > 1:
             from distributed_matvec_tpu.parallel.distributed import (
                 DistributedEngine)
             eng = DistributedEngine(cfg.hamiltonian, n_devices=args.devices,

@@ -135,15 +135,20 @@ def enumerate_to_shards(
                 lib, n_sites, hamming_weight, group,
                 n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol):
             owner = shard_index(slab_s, D)
+            # single-pass scatter: stable sort by owner keeps each shard's
+            # slice in the slab's (ascending) state order
+            order = np.argsort(owner, kind="stable")
+            s_sorted = slab_s[order]
+            n_sorted = slab_n[order]
+            bounds = np.searchsorted(owner[order], np.arange(D + 1))
             for d in range(D):
-                sel = owner == d
-                c = int(sel.sum())
-                if not c:
+                lo, hi = bounds[d], bounds[d + 1]
+                if lo == hi:
                     continue
-                pend_s[d].append(slab_s[sel])
-                pend_n[d].append(slab_n[sel])
-                pending[d] += c
-                counts[d] += c
+                pend_s[d].append(s_sorted[lo:hi])
+                pend_n[d].append(n_sorted[lo:hi])
+                pending[d] += hi - lo
+                counts[d] += hi - lo
                 if pending[d] >= flush_elems:
                     flush(d)
             done += slab_s.size

@@ -53,7 +53,6 @@ sorted (*block*) order.  Batches ``[D, M, k]`` are supported end-to-end.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional
 
 import jax
@@ -105,10 +104,9 @@ class DistributedEngine:
                  batch_size: Optional[int] = None,
                  mode: Optional[str] = None,
                  structure_cache: Optional[str] = None,
-                 layout: Optional[HashedLayout] = None):
+                 layout: Optional[HashedLayout] = None,
+                 shards_path: Optional[str] = None):
         basis = operator.basis
-        if not basis.is_built:
-            basis.build()
         cfg = get_config()
         mode = mode or cfg.matvec_mode
         if mode not in ("ell", "compact", "fused"):
@@ -132,43 +130,106 @@ class DistributedEngine:
             else jnp.complex128
         self.timer = TreeTimer("DistributedEngine")
 
-        reps, norms = basis.representatives, basis.norms
         D = self.n_devices
-        # several engines over the SAME basis (H + observables) can share
-        # one layout: the hash partition is a pure function of (reps, D),
-        # so recomputing it per engine would repeat O(N) host hashing
-        if layout is not None:
-            if layout.n_shards != D or layout.n_global != reps.size:
+        self._shards_path = shards_path
+        if shards_path is not None:
+            # shard-native construction: per-shard representative/norm rows
+            # come straight from the sharded-enumeration file
+            # (enumeration/sharded.py) — the global array NEVER exists, the
+            # regime the reference's distributed enumeration targets
+            # (StatesEnumeration.chpl:305-514, README.md:69-116).  The
+            # global block-order layout is materialized lazily only if a
+            # caller insists on to_hashed/from_hashed.
+            from ..enumeration.sharded import load_shard, shard_manifest
+            man = shard_manifest(shards_path)
+            if man is None:
+                raise ValueError(f"no shard manifest at {shards_path}")
+            if man["n_shards"] != D:
                 raise ValueError(
-                    f"shared layout is for {layout.n_global} states on "
-                    f"{layout.n_shards} shards, engine needs {reps.size} "
-                    f"on {D}")
-            self.layout = layout
-        else:
-            self.layout = HashedLayout(reps, D)
-        M = self.layout.shard_size
-        self.n_states = reps.size
-        self.shard_size = M
+                    f"shard file has {man['n_shards']} shards, mesh has {D}")
+            counts = np.asarray(man["counts"], np.int64)
+            self.n_states = int(man["total"])
+            M = _round_up(int(counts.max()), 128)   # = HashedLayout padding
+            self.layout = None
+            if structure_cache:
+                log_debug("structure_cache ignored for shard-native engines "
+                          "(fingerprint needs the global basis)")
+                structure_cache = None
 
-        # Per-shard sorted representative/norm arrays [D, M] (SENTINEL pad).
-        alphas = self.layout.to_hashed(reps, fill=SENTINEL_STATE)
-        nrm = self.layout.to_hashed(norms, fill=1.0)
+            def shard_rows(d):
+                s, w = load_shard(shards_path, d)
+                a = np.full(M, SENTINEL_STATE, np.uint64)
+                a[: s.size] = s
+                nn = np.ones(M)
+                nn[: w.size] = w
+                return a, nn
+        else:
+            if not basis.is_built:
+                basis.build()
+            reps, norms = basis.representatives, basis.norms
+            # several engines over the SAME basis (H + observables) can
+            # share one layout: the hash partition is a pure function of
+            # (reps, D), so recomputing it per engine would repeat O(N)
+            # host hashing
+            if layout is not None:
+                if layout.n_shards != D or layout.n_global != reps.size:
+                    raise ValueError(
+                        f"shared layout is for {layout.n_global} states on "
+                        f"{layout.n_shards} shards, engine needs "
+                        f"{reps.size} on {D}")
+                self.layout = layout
+            else:
+                self.layout = HashedLayout(reps, D)
+            counts = self.layout.counts
+            M = self.layout.shard_size
+            self.n_states = reps.size
+            alphas_all = self.layout.to_hashed(reps, fill=SENTINEL_STATE)
+            norms_all = self.layout.to_hashed(norms, fill=1.0)
+
+            def shard_rows(d):
+                return alphas_all[d], norms_all[d]
+
+        self.shard_size = M
+        self.counts = counts
         self.tables = K.device_tables(operator, pair=self.pair)
         self.num_terms = int(self.tables.off.x.shape[0])
-
         self._sh1 = shard_spec(self.mesh, 2)
         self._sh2 = shard_spec(self.mesh, 3)
-        put = partial(jax.device_put, device=self._sh1)
-        self._alphas = put(jnp.asarray(alphas))
-        self._norms = put(jnp.asarray(nrm))
-        dd = np.asarray(jax.jit(K.apply_diag)(
-            self.tables.diag, jnp.asarray(alphas.reshape(-1)))).reshape(D, M)
-        self._diag = put(jnp.asarray(
-            np.where(alphas != SENTINEL_STATE, dd, 0.0)))
+
+        # Per-shard sorted representative/norm/diag rows ([M], SENTINEL
+        # pad), shipped to their device one shard at a time; this process
+        # loads only its addressable shards.
+        alpha_rows = [None] * D
+        norm_rows = [None] * D
+        diag_rows = [None] * D
+        diag_fn = jax.jit(K.apply_diag)
+        for d in range(D):
+            if not self._shard_addressable(d):
+                continue
+            a, w = shard_rows(d)
+            alpha_rows[d], norm_rows[d] = a, w
+            dd = np.asarray(diag_fn(self.tables.diag, jnp.asarray(a)))
+            diag_rows[d] = np.where(a != SENTINEL_STATE, dd, 0.0)
+        self._alphas = self._assemble_sharded(alpha_rows)
+        self._norms = self._assemble_sharded(norm_rows)
+        self._diag = self._assemble_sharded(diag_rows)
 
         b = min(batch_size or cfg.matvec_batch_size, M)
         self.batch_size = _round_up(min(b, M), 8)
         self._checked = False
+
+        if mode in ("ell", "compact"):
+            # the routing-plan build cross-searches every peer's rows, so
+            # it needs all shards host-side (plan modes are for bases whose
+            # packed tables fit device memory anyway; the biggest bases use
+            # fused mode, which stays shard-local)
+            if shards_path is not None:
+                rows = [shard_rows(d) for d in range(D)]
+                alphas_h = np.stack([r[0] for r in rows])
+                norms_h = np.stack([r[1] for r in rows])
+                del rows
+            else:
+                alphas_h, norms_h = alphas_all, norms_all
 
         #: True when the plan came from a ``structure_cache`` restore rather
         #: than a fresh host-coordinated build.
@@ -177,16 +238,16 @@ class DistributedEngine:
             self.structure_restored = self._try_load_structure(structure_cache)
             if not self.structure_restored:
                 with self.timer.scope("build_plan"):
-                    self._build_plan(alphas, nrm)
+                    self._build_plan(alphas_h, norms_h)
                 self._save_structure(structure_cache)
             self._matvec = self._make_ell_matvec()
             self._checked = True
         elif mode == "compact":
             self.structure_restored = self._try_load_structure(
-                structure_cache, norms_h=nrm)
+                structure_cache, norms_h=norms_h)
             if not self.structure_restored:
                 with self.timer.scope("build_plan"):
-                    self._build_compact_plan(alphas, nrm)
+                    self._build_compact_plan(alphas_h, norms_h)
                 self._save_structure(structure_cache)
                 self._c_n_all = None   # only needed by the save just done
             self._matvec = self._make_compact_matvec()
@@ -199,28 +260,70 @@ class DistributedEngine:
             # globally from the largest shard so every shard shares one
             # shift and the stacked [D, 2^b+1] table is uniform.
             from ..ops.bits import choose_dir_bits
-            counts = self.layout.counts
             n_bits = basis.number_bits
             b_global = choose_dir_bits(int(counts.max()), n_bits)
-            lks = [build_sorted_lookup(alphas[d][: counts[d]], n_bits,
-                                       dir_bits=b_global)
-                   for d in range(D)]
-            self._lk_shift = lks[0][2]
-            self._lk_probes = max(lk[3] for lk in lks)
-            pair = np.full((D, M, 2), 0xFFFFFFFF, np.uint32)
-            dir_tab = np.empty((D, lks[0][1].shape[0]), np.int32)
+            pair_rows = [None] * D
+            dir_rows = [None] * D
+            probes = 0
+            self._lk_shift = None
             for d in range(D):
-                pair[d, : counts[d]] = lks[d][0]
+                if alpha_rows[d] is None:
+                    continue
+                lk = build_sorted_lookup(alpha_rows[d][: counts[d]], n_bits,
+                                         dir_bits=b_global)
+                self._lk_shift = lk[2]
+                probes = max(probes, lk[3])
+                pr = np.full((M, 2), 0xFFFFFFFF, np.uint32)
+                pr[: counts[d]] = lk[0]
                 if 0 < counts[d] < M:
                     # pad with the last real row: a probe that clamps past
                     # the prefix then can't spuriously match SENTINEL queries
-                    pair[d, counts[d]:] = lks[d][0][-1]
-                dir_tab[d] = lks[d][1]
-            self._lk_pair = jax.device_put(jnp.asarray(pair), self._sh2)
-            self._lk_dir = jax.device_put(jnp.asarray(dir_tab), self._sh1)
+                    pr[counts[d]:] = lk[0][-1]
+                pair_rows[d] = pr
+                dir_rows[d] = lk[1]
+            if jax.process_count() > 1:
+                # probes is data-dependent per shard; the program constant
+                # must agree across processes
+                from jax.experimental import multihost_utils
+                probes = int(np.max(multihost_utils.process_allgather(
+                    np.int32(probes))))
+            self._lk_probes = probes
+            self._lk_pair = self._assemble_sharded(pair_rows)
+            self._lk_dir = self._assemble_sharded(dir_rows)
             self._capacity = self._fused_capacity()
             self._matvec = self._make_fused_matvec()
         self.timer.report()  # tree print, gated by display_timings
+
+    @classmethod
+    def from_shards(cls, operator: Operator, shards_path: str,
+                    mesh: Optional[Mesh] = None,
+                    n_devices: Optional[int] = None,
+                    batch_size: Optional[int] = None,
+                    mode: Optional[str] = None) -> "DistributedEngine":
+        """Engine straight from a sharded-enumeration file — the basis is
+        never built globally (see ``enumeration/sharded.py``); vectors are
+        born hashed (:meth:`random_hashed`) and the solvers never leave the
+        hashed space.  ``to_hashed``/``from_hashed`` still work for
+        moderate sizes by materializing the global layout lazily."""
+        return cls(operator, mesh=mesh, n_devices=n_devices,
+                   batch_size=batch_size, mode=mode or "fused",
+                   shards_path=shards_path)
+
+    def _require_layout(self) -> HashedLayout:
+        """The global block-order layout; for shard-native engines it is
+        materialized on first use (O(N) host memory — fine at test sizes,
+        intentionally NOT on the scale path)."""
+        if self.layout is None:
+            from ..enumeration.sharded import load_shard
+            log_debug("materializing global layout from shards "
+                      f"({self.n_states} states)")
+            states = np.concatenate(
+                [load_shard(self._shards_path, d)[0]
+                 for d in range(self.n_devices)])
+            states.sort()
+            self.layout = HashedLayout(states, self.n_devices)
+        return self.layout
+
 
     # ------------------------------------------------------------------
     # ELL/compact modes: static routing plan (streaming two-pass build)
@@ -996,7 +1099,7 @@ class DistributedEngine:
         x = np.asarray(x)
         if self.pair and np.iscomplexobj(x):
             x = K.pair_from_complex(x)
-        xh = self.layout.to_hashed(x, fill=0)
+        xh = self._require_layout().to_hashed(x, fill=0)
         return jax.device_put(jnp.asarray(xh), shard_spec(self.mesh, xh.ndim))
 
     def from_hashed(self, xh) -> np.ndarray:
@@ -1008,16 +1111,27 @@ class DistributedEngine:
             # (HashedToBlock.chpl:67-153)
             from jax.experimental import multihost_utils
             xh = multihost_utils.process_allgather(xh, tiled=True)
-        return self.layout.from_hashed(np.asarray(xh))
+        return self._require_layout().from_hashed(np.asarray(xh))
 
     def random_hashed(self, seed: int = 0):
-        """A normalized random vector directly in hashed layout (pads zero)."""
-        rng = np.random.default_rng(seed)
-        x = rng.standard_normal(self.n_states)
-        if self.pair:
-            x = np.stack([x, rng.standard_normal(self.n_states)], axis=-1)
-        x /= np.linalg.norm(x)
-        return self.to_hashed(x)
+        """A normalized random vector directly in hashed layout (pads
+        zero).  Generated per shard (deterministic in (seed, shard)), so a
+        shard-native engine never touches a global array; the norm is a
+        device reduction over the sharded vector."""
+        D, M = self.n_devices, self.shard_size
+        rows = [None] * D
+        for d in range(D):
+            if not self._shard_addressable(d):
+                continue
+            rng = np.random.default_rng(
+                np.random.SeedSequence((seed, d)))
+            c = int(self.counts[d])
+            x = np.zeros((M, 2) if self.pair else M)
+            x[:c] = rng.standard_normal((c, 2) if self.pair else c)
+            rows[d] = x
+        xh = self._assemble_sharded(rows)
+        nrm = jax.jit(lambda a: jnp.sqrt(jnp.sum(a * a)))(xh)
+        return jax.jit(jnp.divide)(xh, nrm)
 
     def matvec(self, xh, check: Optional[bool] = None) -> jax.Array:
         """y = H·x in hashed layout ([D, M] or [D, M, k]).
