@@ -245,15 +245,23 @@ def main(argv=None):
 
         def expectation(obs):
             p = obs_input(obs)
-            if args.devices and args.devices > 1:
+            if hasattr(eng, "from_hashed"):
                 from distributed_matvec_tpu.parallel.distributed import (
                     DistributedEngine)
                 # share H's mesh and hash layout (pure functions of the
                 # basis + device count) and reuse the shuffled |psi> per
                 # engine form — only the fused kernel tables differ per
-                # observable
-                oeng = DistributedEngine(obs, mesh=eng.mesh, mode="fused",
-                                         layout=eng.layout)
+                # observable.  A shard-native engine's observables come
+                # from the SAME shard file (the basis is still never built
+                # globally); the layout psi's block form already required
+                # is shared, not rebuilt.
+                if args.shards:
+                    oeng = DistributedEngine.from_shards(
+                        obs, args.shards, mesh=eng.mesh, mode="fused")
+                    oeng.layout = eng._require_layout()
+                else:
+                    oeng = DistributedEngine(obs, mesh=eng.mesh,
+                                             mode="fused", layout=eng.layout)
                 key = (oeng.pair, p.dtype.kind, p.ndim)
                 if key not in xh_cache:
                     xh_cache[key] = oeng.to_hashed(p)

@@ -140,6 +140,7 @@ def _stream_native(
     n_chunks: Optional[int] = None,
     n_threads: Optional[int] = None,
     norm_tol: float = 1e-12,
+    batch_tasks: int = 256,
 ):
     """Generator over (states, norms) survivor slabs in ascending state
     order — the chunk ranges are disjoint and ascending, so concatenating
@@ -176,8 +177,10 @@ def _stream_native(
 
     # Survivor capacity per task: candidates/G is the expectation; give 4×
     # headroom + constant. On overflow (-1) retry with the exact bound.
-    # process tasks in batches to bound memory
-    batch = max(1, min(ntasks, 256))
+    # process tasks in batches to bound memory (smaller batches yield
+    # earlier — at huge candidate counts the first, representative-dense
+    # ranges alone can take many minutes)
+    batch = max(1, min(ntasks, batch_tasks))
     use_h = 1 if hamming_weight not in (None, 0) else 0
     for b0 in range(0, ntasks, batch):
         b1 = min(b0 + batch, ntasks)

@@ -133,7 +133,8 @@ def enumerate_to_shards(
         done = 0
         for slab_s, slab_n in _native._stream_native(
                 lib, n_sites, hamming_weight, group,
-                n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol):
+                n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol,
+                batch_tasks=32):
             owner = shard_index(slab_s, D)
             # single-pass scatter: stable sort by owner keeps each shard's
             # slice in the slab's (ascending) state order

@@ -1,0 +1,155 @@
+"""Chunked / per-shard vector I/O for the ≥10⁹-state regime.
+
+The reference reads and writes big datasets in hyperslab chunks and
+per-locale blocks (``MyHDF5.chpl:105-162, 272-333``) because no locale can
+hold a global array.  The analogs here:
+
+* :func:`stream_block_to_shards` — a block-order (global sorted) dataset,
+  e.g. a golden ``/x`` next to ``/representatives``
+  (input_for_matvec.py:28-46), is read in hyperslab chunks, hash-routed
+  (``localeIdxOf``), and appended to per-shard datasets.  Chunks ascend and
+  block order is ascending-state order, so each shard's stream lands in
+  exactly the per-shard sorted order the engine consumes — this is
+  ``arrFromBlockToHashed`` (BlockToHashed.chpl:87-208) as streaming I/O,
+  with bounded memory.
+* :func:`save_hashed_vector` / :func:`load_hashed_shard` — a hashed
+  ``[D, M(, k)]`` array (eigenvectors, checkpoint state) written one shard
+  at a time with the pad rows stripped, and read back per shard (the
+  per-locale block read of ``readDatasetAsBlocks``, MyHDF5.chpl:272-286).
+  In a multi-process run each process writes/reads only its addressable
+  shards.
+
+Shard-aligned vector files carry the counts they were written with, so a
+consumer can assemble the padded ``[D, M]`` device array directly (see
+``DistributedEngine.from_shards`` for the representative-side analog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..enumeration.host import shard_index
+
+__all__ = ["stream_block_to_shards", "save_hashed_vector",
+           "load_hashed_shard", "hashed_vector_counts"]
+
+_CHUNK = 1 << 20
+
+
+def stream_block_to_shards(src_path: str, out_path: str, n_shards: int,
+                           x_dataset: str = "x",
+                           reps_dataset: str = "representatives",
+                           name: str = "v",
+                           chunk: int = _CHUNK) -> np.ndarray:
+    """Route a block-order dataset into per-shard datasets, chunk by chunk.
+
+    ``src_path[x_dataset]`` may be rank-1 [N] or a batch [k, N] (the golden
+    generator's transposed layout, input_for_matvec.py:43-46); the output
+    shard datasets are [c_d] or [c_d, k].  Returns the per-shard counts.
+    """
+    import h5py
+
+    with h5py.File(src_path, "r") as fin, h5py.File(out_path, "w") as fout:
+        reps = fin[reps_dataset]
+        xd = fin[x_dataset]
+        batch = xd.ndim == 2
+        n = reps.shape[0]
+        if (xd.shape[-1] if batch else xd.shape[0]) != n:
+            raise ValueError(
+                f"{x_dataset} has {xd.shape} entries for {n} representatives")
+        counts = np.zeros(n_shards, np.int64)
+        g = fout.create_group(f"vector_shards/{name}")
+        dsets = []
+        for d in range(n_shards):
+            shape = (0, xd.shape[0]) if batch else (0,)
+            maxshape = (None, xd.shape[0]) if batch else (None,)
+            chunks = (min(chunk, _CHUNK),) + ((xd.shape[0],) if batch else ())
+            dsets.append(g.create_dataset(str(d), shape=shape,
+                                          maxshape=maxshape, dtype=xd.dtype,
+                                          chunks=chunks))
+        for s in range(0, n, chunk):
+            e = min(s + chunk, n)
+            r_c = reps[s:e]
+            x_c = xd[:, s:e].T if batch else xd[s:e]
+            owner = shard_index(np.asarray(r_c, np.uint64), n_shards)
+            order = np.argsort(owner, kind="stable")
+            x_s = x_c[order]
+            bounds = np.searchsorted(owner[order], np.arange(n_shards + 1))
+            for d in range(n_shards):
+                lo, hi = bounds[d], bounds[d + 1]
+                if lo == hi:
+                    continue
+                ds = dsets[d]
+                o = ds.shape[0]
+                ds.resize((o + hi - lo,) + ds.shape[1:])
+                ds[o:] = x_s[lo:hi]
+                counts[d] += hi - lo
+        fout.attrs["counts"] = counts
+        fout.attrs["n_shards"] = n_shards
+    return counts
+
+
+def save_hashed_vector(path: str, xh, counts, name: str = "v") -> None:
+    """Write a hashed ``[D, M(, k)]`` array one shard at a time, pad rows
+    stripped; only shards addressable by this process are written (pass the
+    same ``counts`` the layout/manifest carries).
+
+    HDF5 has no concurrent-writer support, so in a multi-process run each
+    rank writes its OWN file (``path.r<rank>``); :func:`load_hashed_shard`
+    finds a shard in whichever file holds it."""
+    import h5py
+    import jax
+
+    counts = np.asarray(counts, np.int64)
+    D = counts.size
+    if jax.process_count() > 1:
+        path = f"{path}.r{jax.process_index()}"
+    with h5py.File(path, "a") as f:
+        g = f.require_group(f"vector_shards/{name}")
+        for d in range(D):
+            shard = None
+            if isinstance(xh, jax.Array):
+                for piece in xh.addressable_shards:
+                    if piece.index[0].start == d:
+                        shard = np.asarray(piece.data)[0]
+                        break
+                if shard is None:
+                    continue            # another process's shard
+            else:
+                shard = np.asarray(xh)[d]
+            key = str(d)
+            if key in g:
+                del g[key]
+            g.create_dataset(key, data=shard[: counts[d]])
+        f.attrs["counts"] = counts
+        f.attrs["n_shards"] = D
+
+
+def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:
+    """One shard's rows of a saved hashed vector (pad rows NOT included).
+    Looks in ``path`` first, then in any per-rank ``path.r*`` files a
+    multi-process save produced."""
+    import glob
+    import h5py
+
+    key = f"vector_shards/{name}"
+    for cand in [path] + sorted(glob.glob(f"{path}.r*")):
+        try:
+            with h5py.File(cand, "r") as f:
+                if key in f and str(d) in f[key]:
+                    return f[key][str(d)][...]
+        except OSError:
+            continue
+    raise KeyError(f"shard {d} of {name!r} not found under {path}(.r*)")
+
+
+def hashed_vector_counts(path: str) -> Optional[np.ndarray]:
+    import h5py
+
+    try:
+        with h5py.File(path, "r") as f:
+            return np.asarray(f.attrs["counts"], np.int64)
+    except (OSError, KeyError):
+        return None

@@ -224,7 +224,9 @@ class DistributedEngine:
             # packed tables fit device memory anyway; the biggest bases use
             # fused mode, which stays shard-local)
             if shards_path is not None:
-                rows = [shard_rows(d) for d in range(D)]
+                rows = [(alpha_rows[d], norm_rows[d])
+                        if alpha_rows[d] is not None else shard_rows(d)
+                        for d in range(D)]
                 alphas_h = np.stack([r[0] for r in rows])
                 norms_h = np.stack([r[1] for r in rows])
                 del rows
