@@ -360,16 +360,27 @@ def test_plan_build_memory_bounded():
 
 
 @pytest.mark.slow
-def test_multihost_two_process():
+def test_multihost_two_process(tmp_path):
     """A REAL multi-controller run: 2 jax.distributed processes, 4 CPU
     devices each, one 8-device mesh — the DCN analog of the reference's
     GASNet substrates (env/chpl-env-*.sh).  Each process packs only its
     addressable plan shards; all three engine modes matvec + a Lanczos
-    block against single-process truth (multihost_worker.py)."""
+    block against single-process truth, then a shard-native from_shards
+    engine where each process loads only its own shards from the file
+    (multihost_worker.py)."""
     import os
     import socket
     import subprocess
     import sys
+
+    from distributed_matvec_tpu.enumeration.native import native_available
+    from distributed_matvec_tpu.enumeration.sharded import enumerate_to_shards
+
+    shards = ""
+    if native_available():
+        b = SpinBasis(12, 6)
+        shards = str(tmp_path / "mh_shards.h5")
+        enumerate_to_shards(12, 6, b.group, 8, shards)
 
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     with socket.socket() as s:              # free port for the coordinator
@@ -378,7 +389,8 @@ def test_multihost_two_process():
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", str(port)],
+        [sys.executable, worker, str(pid), "2", str(port)]
+        + ([shards] if shards else []),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
         for pid in range(2)]
     outs = []
@@ -393,3 +405,5 @@ def test_multihost_two_process():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
         assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
+        if shards:      # the shard-native leg must actually have run
+            assert f"[p{pid}] from_shards E0/4" in out, out[-2000:]
