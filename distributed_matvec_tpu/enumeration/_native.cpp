@@ -141,6 +141,59 @@ int64_t dmt_enumerate_ranges(const uint64_t *starts, const uint64_t *ends,
   return failed.load() ? -1 : 0;
 }
 
+// Routing-plan hot loop: for each generated state β, the owning shard
+// (splitmix64 finalizer % D — bit-identical to StatesEnumeration.chpl's
+// hash64_01, :122-136) and β's position in the owner's sorted
+// representative prefix.  One threaded pass replaces a per-peer
+// mask + searchsorted sweep on the build host.
+static inline uint64_t splitmix64_fin(uint64_t x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+int64_t dmt_lookup_owners(const uint64_t *betas, int64_t n,
+                          const uint64_t *alphas,  // [D*M] row-major, sorted
+                          const int64_t *counts,   // [D] real prefix sizes
+                          int64_t D, int64_t M,
+                          int32_t *out_owner, int32_t *out_idx,
+                          uint8_t *out_found, int nthreads) {
+  std::atomic<int64_t> next(0);
+  const int64_t chunk = 1 << 16;
+  const int64_t nchunks = (n + chunk - 1) / chunk;
+  if (nchunks < (int64_t)nthreads) nthreads = (int)(nchunks > 0 ? nchunks : 1);
+  auto worker = [&]() {
+    while (true) {
+      const int64_t s = next.fetch_add(chunk);
+      if (s >= n) break;
+      const int64_t e = s + chunk < n ? s + chunk : n;
+      for (int64_t i = s; i < e; ++i) {
+        const uint64_t b = betas[i];
+        const int64_t d = D > 1 ? (int64_t)(splitmix64_fin(b) % (uint64_t)D)
+                                : 0;
+        const uint64_t *a = alphas + d * M;
+        int64_t lo = 0, hi = counts[d];
+        while (lo < hi) {  // lower_bound
+          const int64_t mid = (lo + hi) >> 1;
+          if (a[mid] < b) lo = mid + 1; else hi = mid;
+        }
+        out_owner[i] = (int32_t)d;
+        const int found = lo < counts[d] && a[lo] == b;
+        out_idx[i] = (int32_t)(found ? lo : 0);
+        out_found[i] = (uint8_t)found;
+      }
+    }
+  };
+  if (nthreads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (int i = 0; i < nthreads; ++i) pool.emplace_back(worker);
+    for (auto &th : pool) th.join();
+  }
+  return 0;
+}
+
 // Count states with the same popcount in [lo, hi] (for capacity planning /
 // unprojected fill).
 int64_t dmt_count_fixed_hamming(uint64_t lo, uint64_t hi) {

@@ -31,7 +31,8 @@ import numpy as np
 from . import host as _host
 from ..utils.logging import log_debug
 
-__all__ = ["native_available", "enumerate_representatives_native"]
+__all__ = ["native_available", "enumerate_representatives_native",
+           "lookup_owners"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_native.cpp")
@@ -56,13 +57,22 @@ class _Group(ctypes.Structure):
 def _build() -> Optional[str]:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
+    # compile to a temp name and rename: writing the .so in place would
+    # clobber the text mapping of any process that already dlopened it
+    # (a long-running enumeration would SIGBUS mid-flight)
+    tmp = _SO + f".build{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-o", _SO, _SRC, "-lpthread"]
+           "-o", tmp, _SRC, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return _SO
     except Exception as e:  # no compiler / sandboxed FS → NumPy fallback
         log_debug(f"native enumeration unavailable ({e}); using NumPy path")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
@@ -89,6 +99,14 @@ def _load():
         lib.dmt_fill_fixed_hamming.argtypes = [
             ctypes.c_uint64, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+        ]
+        lib.dmt_lookup_owners.restype = ctypes.c_int64
+        lib.dmt_lookup_owners.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
         ]
         _lib = lib
         return _lib
@@ -247,3 +265,34 @@ def enumerate_representatives_native(
     if not parts_s:
         return (np.empty(0, np.uint64), np.empty(0, np.float64))
     return np.concatenate(parts_s), np.concatenate(parts_n)
+
+
+def lookup_owners(betas: np.ndarray, alphas: np.ndarray,
+                  counts: np.ndarray,
+                  n_threads: Optional[int] = None):
+    """(owner, idx, found) for each state in ``betas`` against the per-shard
+    sorted representative prefixes ``alphas[d][:counts[d]]`` — the routing
+    plan's hot host loop in one threaded native pass.  Returns None when
+    the kernel is unavailable (callers fall back to NumPy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    betas = np.ascontiguousarray(betas, np.uint64)
+    alphas = np.ascontiguousarray(alphas, np.uint64)
+    counts = np.ascontiguousarray(counts, np.int64)
+    D, M = alphas.shape
+    n = betas.size
+    owner = np.empty(n, np.int32)
+    idx = np.empty(n, np.int32)
+    found = np.empty(n, np.uint8)
+    lib.dmt_lookup_owners(
+        betas.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
+        alphas.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        D, M,
+        owner.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(n_threads or os.cpu_count() or 1),
+    )
+    return owner, idx, found.astype(bool)

@@ -395,7 +395,8 @@ class DistributedEngine:
         slot — a ~T× larger exchange for dense operators).
         """
         D, M, T = self.n_devices, self.shard_size, self.num_terms
-        from ..enumeration.host import hash64 as hash64_host
+        from ..enumeration.host import shard_index as shard_index_host
+        from ..enumeration.native import lookup_owners as native_lookup
 
         Bc = min(M, max(self.batch_size, 8))
         nchunks = (M + Bc - 1) // Bc
@@ -422,9 +423,30 @@ class DistributedEngine:
                     # plan building is host-side math — c128 is fine here
                     cf = K.complex_from_pair(cf)
                 nz = (cf != 0) & (a_c != SENTINEL_STATE)[:, None]
-                owner = ((hash64_host(betas) % np.uint64(D)).astype(np.int32)
-                         if D > 1 else np.zeros(betas.shape, np.int32))
-                yield s, e, n_c, betas, cf, nz, np.where(nz, owner, -1)
+                yield s, e, n_c, betas, cf, nz
+
+        def lookup_live(betas, nz):
+            """(owner, idx, found) for the live entries ``betas[nz]`` —
+            one threaded native pass (hash + per-shard binary search,
+            enumeration/_native.cpp::dmt_lookup_owners) with a vectorized
+            NumPy fallback."""
+            flat_b = betas[nz]
+            got = native_lookup(flat_b, alphas_h, self.counts)
+            if got is not None:
+                return got
+            owner = shard_index_host(flat_b, D)
+            idx = np.zeros(flat_b.size, np.int32)
+            found = np.zeros(flat_b.size, bool)
+            for p in range(D):
+                sel = owner == p
+                if not sel.any():
+                    continue
+                ip = np.searchsorted(alphas_h[p], flat_b[sel])
+                np.clip(ip, 0, M - 1, out=ip)
+                ok = alphas_h[p][ip] == flat_b[sel]
+                idx[sel] = np.where(ok, ip, 0).astype(np.int32)
+                found[sel] = ok
+            return owner, idx, found
 
         # -- pass 1: row-nnz counts, remote-target dedup, sector check -----
         nnz = np.zeros((D, M), np.int32)
@@ -432,19 +454,12 @@ class DistributedEngine:
         bad = 0
         for d in range(D):
             mark = np.zeros((D, M), bool)   # remote targets seen, per peer
-            for s, e, n_c, betas, cf, nz, owner in chunks(d):
+            for s, e, n_c, betas, cf, nz in chunks(d):
                 nnz[d, s:e] = nz.sum(axis=1)[: e - s]
-                for p in range(D):
-                    sel = owner == p
-                    if not sel.any():
-                        continue
-                    b_p = betas[sel]
-                    ip = np.searchsorted(alphas_h[p], b_p)
-                    np.clip(ip, 0, M - 1, out=ip)
-                    ok = alphas_h[p][ip] == b_p
-                    bad += int((~ok).sum())
-                    if p != d:
-                        mark[p, ip[ok]] = True
+                owner, idx, found = lookup_live(betas, nz)
+                bad += int((~found).sum())
+                rem = found & (owner != d)
+                mark[owner[rem], idx[rem]] = True
                 log_debug(f"plan pass1 shard {d}: rows {e}/{M}")
             for p in range(D):
                 if p != d:
@@ -510,18 +525,15 @@ class DistributedEngine:
                       else np.zeros((Tw, S_max), cdtype))
             i_tail = None if compact else np.zeros((Tw, S_max), np.int32)
             t_cursor = 0
-            for s, e, n_c, betas, cf, nz, owner in chunks(d):
+            for s, e, n_c, betas, cf, nz in chunks(d):
+                owner, idx, found = lookup_live(betas, nz)
                 g = np.zeros(betas.shape, np.int64)
-                n_b = np.ones(betas.shape) if compact else None
-                for p in range(D):
-                    sel = owner == p
-                    if not sel.any():
-                        continue
-                    ip = np.searchsorted(alphas_h[p], betas[sel])
-                    np.clip(ip, 0, M - 1, out=ip)
-                    g[sel] = ip if p == d else M + p * C + slot[p, ip]
-                    if compact:
-                        n_b[sel] = norms_h[p][ip]
+                g[nz] = np.where(owner == d, idx.astype(np.int64),
+                                 M + owner.astype(np.int64) * C
+                                 + slot[owner, idx])
+                if compact:
+                    n_b = np.ones(betas.shape)
+                    n_b[nz] = norms_h[owner, idx]
                 cfz = np.where(nz, cf, 0)
                 if compact:
                     ratio = np.abs(cfz) * n_c[:, None] / n_b

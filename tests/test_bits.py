@@ -55,3 +55,46 @@ def test_bucketed_lookup_single_entry():
         pair, dir_tab, q, shift=shift, probes=probes))
     np.testing.assert_array_equal(found, [False, True, False, False])
     assert idx[1] == 0
+
+
+def test_native_lookup_owners_matches_numpy():
+    """dmt_lookup_owners (threaded hash + per-shard binary search) must be
+    bit-identical to the NumPy owner/searchsorted path, including misses."""
+    import numpy as np
+    import pytest
+
+    from distributed_matvec_tpu.enumeration.native import (lookup_owners,
+                                                           native_available)
+    from distributed_matvec_tpu.enumeration.host import hash64, shard_index
+
+    if not native_available():
+        pytest.skip("native kernel unavailable")
+    rng = np.random.default_rng(11)
+    D, M = 8, 512
+    # per-shard sorted prefixes with SENTINEL padding
+    SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+    counts = rng.integers(1, M, size=D)
+    pool = np.sort(rng.choice(1 << 30, size=4096, replace=False)
+                   .astype(np.uint64))
+    owner_pool = shard_index(pool, D)
+    alphas = np.full((D, M), SENT, np.uint64)
+    for d in range(D):
+        mine = pool[owner_pool == d][: counts[d]]
+        counts[d] = mine.size
+        alphas[d, : mine.size] = mine
+
+    # queries: half present, half absent (but hashed to some shard)
+    present = pool[rng.integers(0, pool.size, 3000)]
+    absent = rng.choice(1 << 30, size=3000).astype(np.uint64)
+    betas = np.concatenate([present, absent])
+    rng.shuffle(betas)
+
+    owner, idx, found = lookup_owners(betas, alphas, counts)
+    np.testing.assert_array_equal(owner, shard_index(betas, D))
+    for i in range(betas.size):
+        d = owner[i]
+        ip = np.searchsorted(alphas[d, : counts[d]], betas[i])
+        ok = ip < counts[d] and alphas[d, ip] == betas[i]
+        assert found[i] == ok
+        if ok:
+            assert idx[i] == ip
