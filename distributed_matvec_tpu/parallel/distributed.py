@@ -406,7 +406,7 @@ class DistributedEngine:
             return K.gather_coefficients(tables, alphas, norms_a)
 
         def chunks(d):
-            """Yield (s, e, n_c, betas, cf, nz, owner) per row chunk, all
+            """Yield (s, e, n_c, betas, cf, nz) per row chunk, all
             padded to Bc rows (SENTINEL rows carry cf == 0)."""
             for ci in range(nchunks):
                 s, e = ci * Bc, min((ci + 1) * Bc, M)

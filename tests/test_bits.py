@@ -65,7 +65,7 @@ def test_native_lookup_owners_matches_numpy():
 
     from distributed_matvec_tpu.enumeration.native import (lookup_owners,
                                                            native_available)
-    from distributed_matvec_tpu.enumeration.host import hash64, shard_index
+    from distributed_matvec_tpu.enumeration.host import shard_index
 
     if not native_available():
         pytest.skip("native kernel unavailable")

@@ -2,8 +2,11 @@
 ``input_for_matvec.py`` (seed 42, :8; writes /representatives, /x, /y per
 system, :28-46).  The reference generates goldens with the *independent*
 OpenMP ``lattice_symmetries`` package; here the trusted path is the host
-(NumPy) matvec, which is itself validated against the independent dense
-Kronecker/projector reference (tests/dense_ref.py) for every small system.
+(NumPy) matvec, which is validated against the independent dense
+Kronecker/projector reference (tests/dense_ref.py) for every small system
+— and, for unprojected Heisenberg rings, every golden is ADDITIONALLY
+cross-checked at generation time against the term-compiler-independent
+bit-op apply (tests/independent_ref.py); a mismatch refuses to write.
 
 Usage::
 
@@ -21,12 +24,15 @@ from __future__ import annotations
 import argparse
 import glob
 import os
+import re
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
 
 SEED = 42  # input_for_matvec.py:8
 REFERENCE_DATA = "/root/reference/data"
@@ -55,10 +61,24 @@ def generate(yaml_path: str, out_dir: str,
     x = rng.standard_normal(n)
     x /= np.linalg.norm(x)
     y = cfg.hamiltonian.matvec_host(x)
+    checked = ""
+    if (re.fullmatch(r"heisenberg_chain_\d+", name)
+            and not cfg.basis.requires_projection
+            and cfg.basis.hamming_weight == cfg.basis.number_spins // 2):
+        from independent_ref import heisenberg_ring_apply
+
+        y_ind = heisenberg_ring_apply(cfg.basis.representatives,
+                                      cfg.basis.number_spins, x)
+        if not np.allclose(y, y_ind, atol=1e-13, rtol=1e-12):
+            raise RuntimeError(
+                f"{name}: matvec_host disagrees with the independent "
+                "bit-op apply — refusing to write a golden")
+        checked = " [independent-checked]"
     dest = os.path.join(out_dir, "matvec", f"{name}.h5")
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     save_golden(dest, cfg.basis.representatives, x, y)
-    print(f"  {name}: N={n} written in {time.perf_counter() - t0:.2f}s")
+    print(f"  {name}: N={n} written in "
+          f"{time.perf_counter() - t0:.2f}s{checked}")
     return dest
 
 
