@@ -75,6 +75,16 @@ def main(argv=None):
                          "re-materialize the global arrays)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
+    ap.add_argument("--solver-checkpoint", default=None, metavar="CKPT_H5",
+                    help="mid-solve Lanczos checkpoint/resume file (beyond "
+                         "the reference: PRIMME state is never saved there); "
+                         "a rerun with the same config resumes the Krylov "
+                         "recurrence where it stopped")
+    ap.add_argument("--checkpoint-every", type=int, default=4,
+                    help="solver-checkpoint cadence in convergence-check "
+                         "blocks (each block is check_every=16 iterations; "
+                         "the write costs a basis fetch, so raise this at "
+                         "large N)")
     ap.add_argument("--no-eigenvectors", action="store_true",
                     help="skip eigenvector computation/saving")
     ap.add_argument("--observables", action="store_true",
@@ -156,6 +166,10 @@ def main(argv=None):
                 print("--block (LOBPCG) is single-controller; use Lanczos "
                       "(default) for multi-process runs", file=sys.stderr)
                 return 2
+            if args.solver_checkpoint:
+                print("warning: --solver-checkpoint applies to Lanczos "
+                      "only; LOBPCG runs are not checkpointed",
+                      file=sys.stderr)
             evals, evecs_cols, iters = lobpcg(
                 eng.matvec, n, k=args.num_evals, tol=args.tol,
                 max_iters=args.max_iters)
@@ -174,6 +188,8 @@ def main(argv=None):
                           max_iters=args.max_iters,
                           max_basis_size=args.max_basis_size,
                           min_restart_size=args.min_restart_size,
+                          checkpoint_path=args.solver_checkpoint,
+                          checkpoint_every=args.checkpoint_every,
                           compute_eigenvectors=not args.no_eigenvectors)
             evals, residuals, niter = (res.eigenvalues, res.residual_norms,
                                        res.num_iters)

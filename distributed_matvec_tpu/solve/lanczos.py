@@ -55,6 +55,7 @@ class LanczosResult:
     residual_norms: np.ndarray       # [k] |β_m · s_last| bound
     num_iters: int
     converged: bool
+    resumed_from: int = 0            # iterations restored from a checkpoint
     # steady-state rate bookkeeping: the first block pays jit compile, so
     # iters/sec is (num_iters - first_block_iters) / steady_seconds
     first_block_seconds: float = 0.0
@@ -218,6 +219,8 @@ def lanczos(
     min_restart_size: Optional[int] = None,
     check_every: int = 16,
     pair: Optional[bool] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 4,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs of the Hermitian operator behind ``matvec``.
 
@@ -230,6 +233,16 @@ def lanczos(
 
     ``pair`` marks (re, im)-f64 pair vectors (see ``_make_block_runner``);
     default: auto-detected from a pair-mode engine behind ``matvec``.
+
+    ``checkpoint_path`` enables mid-solve checkpoint/resume (something the
+    reference's PRIMME driver cannot do): every ``checkpoint_every``-th
+    block boundary the live Krylov basis + recurrence state are written
+    atomically, and a rerun with the same path, operator, and solver
+    geometry resumes where it left off.  The checkpoint is keyed by the
+    vector shape/dtype and solver geometry; pointing it at a DIFFERENT
+    operator with the same geometry is the caller's responsibility (pass a
+    fresh path per problem).  Single-controller only (the basis fetch is a
+    global read); ignored with a debug log in multi-process runs.
     """
     # Engines expose (apply_fn, operands) so the block runner can pass the
     # matrix tables as jit arguments; plain callables fall back to empty
@@ -300,6 +313,49 @@ def lanczos(
     converged = False
     theta = S = res = None
 
+    # keyed by the vector space only — NOT by solver geometry, so a rerun
+    # with a different max_iters / basis bound still resumes (the saved
+    # rows are valid in any buffer that fits them)
+    ckpt_fp = f"{tuple(shape)}|{np.dtype(dtype).str}|lanczos-v1"
+    resumed_from = 0
+    if checkpoint_path and jax.process_count() > 1:
+        from ..utils.logging import log_debug
+        log_debug("lanczos checkpointing disabled in multi-process runs")
+        checkpoint_path = None
+    if checkpoint_path:
+        from ..io.hdf5 import load_engine_structure
+        got = load_engine_structure(checkpoint_path, ckpt_fp)
+        if got is not None:
+            rows = int(got["V"].shape[0])
+            if rows > _buffer_rows(mcap) or int(got["m"]) > mcap:
+                from ..utils.logging import log_debug
+                log_debug("lanczos checkpoint basis exceeds max_basis_size; "
+                          "starting fresh")
+            else:
+                V = V.at[:rows].set(jnp.asarray(got["V"]))
+                na = min(int(got["m"]), mcap)
+                alph_d = alph_d.at[:na].set(
+                    jnp.asarray(got["alph"][:na]))
+                bet_d = bet_d.at[:na].set(jnp.asarray(got["bet"][:na]))
+                lock_theta = np.asarray(got["lock_theta"])
+                lock_sigma = np.asarray(got["lock_sigma"])
+                m = int(got["m"])
+                total_iters = resumed_from = int(got["total_iters"])
+    blocks_done = 0
+
+    if m:
+        # Rayleigh-Ritz on the restored state up front: a resume whose
+        # budget is already spent still returns the checkpointed estimates
+        # (and may exit converged immediately) instead of empty arrays
+        alph = np.asarray(alph_d)
+        bet = np.asarray(bet_d)
+        kk = min(k, m)
+        T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)
+        theta, S = eigh(T, subset_by_index=(0, kk - 1))
+        res = np.abs(bet[m - 1] * S[m - 1, :])
+        if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
+            converged = True
+
     import time as _time
 
     first_block_s = 0.0
@@ -307,6 +363,20 @@ def lanczos(
     steady_s = 0.0
 
     while total_iters < max_iters and not converged:
+        if m == mcap:
+            # Thick restart at the TOP of the loop (a resumed checkpoint
+            # may arrive with a full buffer): keep the l lowest Ritz
+            # vectors + the residual vector; the projection becomes
+            # arrowhead + tridiagonal.
+            alph = np.asarray(alph_d)
+            bet = np.asarray(bet_d)
+            T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)
+            l = l_restart   # clipped to <= mcap-2 at setup; restart_fn
+            theta_all, S_all = eigh(T)   # hard-codes the residual row at l
+            V = restart_fn(V, jnp.asarray(S_all[:, :l]))
+            lock_theta = theta_all[:l].copy()
+            lock_sigma = bet[m - 1] * S_all[m - 1, :l]
+            m = l
         nsteps = min(check_every, mcap - m, max_iters - total_iters)
         t0 = _time.perf_counter()
         V, alph_d, bet_d = run_block(
@@ -343,15 +413,15 @@ def lanczos(
         if broke is not None:
             break   # Krylov space closed without meeting the tolerance
 
-        if m == mcap and total_iters < max_iters:
-            # Thick restart: keep the l lowest Ritz vectors + the residual
-            # vector; the projection becomes arrowhead + tridiagonal.
-            l = l_restart   # clipped to <= mcap-2 at setup; restart_fn
-            theta_all, S_all = eigh(T)   # hard-codes the residual row at l
-            V = restart_fn(V, jnp.asarray(S_all[:, :l]))
-            lock_theta = theta_all[:l].copy()
-            lock_sigma = bet[m - 1] * S_all[m - 1, :l]
-            m = l
+        blocks_done += 1
+        if checkpoint_path and blocks_done % max(checkpoint_every, 1) == 0:
+            from ..io.hdf5 import save_engine_structure
+            save_engine_structure(checkpoint_path, ckpt_fp, "lanczos", {
+                "V": np.asarray(V[: m + 1]),
+                "alph": np.asarray(alph_d), "bet": np.asarray(bet_d),
+                "lock_theta": np.asarray(lock_theta),
+                "lock_sigma": np.asarray(lock_sigma),
+                "m": int(m), "total_iters": int(total_iters)})
 
     kk = min(k, m)
     evecs = None
@@ -373,6 +443,7 @@ def lanczos(
         residual_norms=np.asarray(res[:kk]) if res is not None
         else np.zeros(0),
         num_iters=total_iters,
+        resumed_from=resumed_from,
         converged=converged,
         first_block_seconds=first_block_s,
         first_block_iters=first_block_iters,

@@ -162,3 +162,74 @@ def test_lobpcg_distributed_pair():
     for i in range(2):
         r = np.linalg.norm(Hd @ V[:, i] - evals[i] * V[:, i])
         assert r < 1e-5, r
+
+
+def test_lanczos_checkpoint_resume(tmp_path):
+    """Mid-solve checkpoint/resume (beyond the reference: PRIMME state is
+    never saved there).  A truncated run checkpoints its Krylov state; the
+    rerun resumes — cumulative iteration count, same converged result as
+    an uninterrupted solve."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((400, 400))
+    A = (A + A.T) / 2
+    Aj = jnp.asarray(A)
+    mv = lambda x: Aj @ x                       # noqa: E731
+    want = np.linalg.eigvalsh(A)[0]
+    ck = str(tmp_path / "lz.h5")
+
+    partial_res = lanczos(mv, 400, k=1, tol=1e-11, max_iters=24,
+                          check_every=8, checkpoint_path=ck,
+                          checkpoint_every=1)
+    assert not partial_res.converged
+    import os
+    assert os.path.exists(ck + ".structure.h5") or os.path.exists(ck)
+
+    # an exhausted-budget resume still returns the checkpointed estimates
+    # instead of empty arrays (loop body never runs)
+    stuck = lanczos(mv, 400, k=1, tol=1e-11, max_iters=24,
+                    check_every=8, checkpoint_path=ck)
+    assert stuck.resumed_from == 24 and stuck.eigenvalues.size == 1
+
+    resumed = lanczos(mv, 400, k=1, tol=1e-11, max_iters=300,
+                      check_every=8, checkpoint_path=ck)
+    assert resumed.resumed_from == 24           # genuinely resumed
+    assert resumed.converged
+    assert resumed.num_iters > 24               # cumulative, not restarted
+    np.testing.assert_allclose(resumed.eigenvalues[0], want, atol=1e-9)
+
+    # a different vector space must MISS the checkpoint, not crash
+    B = A[:300, :300]
+    Bj = jnp.asarray(B)
+    fresh = lanczos(lambda x: Bj @ x, 300, k=1, tol=1e-10, max_iters=300,
+                    check_every=8, checkpoint_path=ck)
+    assert fresh.resumed_from == 0 and fresh.converged
+    np.testing.assert_allclose(fresh.eigenvalues[0],
+                               np.linalg.eigvalsh(B)[0], atol=1e-8)
+
+
+def test_lanczos_checkpoint_resume_restart_boundary(tmp_path):
+    """Resume across a thick-restart boundary: the checkpoint written after
+    a restart carries the arrowhead (lock) state and still converges to
+    the truth."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    A = rng.standard_normal((300, 300))
+    A = (A + A.T) / 2
+    Aj = jnp.asarray(A)
+    mv = lambda x: Aj @ x                       # noqa: E731
+    ck = str(tmp_path / "lz.h5")
+    partial_res = lanczos(mv, 300, k=1, tol=1e-12, max_iters=40,
+                          max_basis_size=24, min_restart_size=8,
+                          check_every=8, checkpoint_path=ck,
+                          checkpoint_every=1)
+    assert not partial_res.converged
+    resumed = lanczos(mv, 300, k=1, tol=1e-12, max_iters=400,
+                      max_basis_size=24, min_restart_size=8,
+                      check_every=8, checkpoint_path=ck)
+    assert resumed.resumed_from == 40
+    assert resumed.converged and resumed.num_iters > 40
+    np.testing.assert_allclose(resumed.eigenvalues[0],
+                               np.linalg.eigvalsh(A)[0], atol=1e-9)
