@@ -822,13 +822,21 @@ class DistributedEngine:
                         lambda a, v: (body(a, v), None), acc, tags[:width])
                 return acc
 
-            acc = terms(jnp.zeros(x.shape, jnp.float64), tags, T0)
+            # zero carries must be marked varying-per-shard before they
+            # enter a lax.scan under shard_map (the body gathers from the
+            # shard-varying xx, so the carry comes back varying; an
+            # unvarying init then fails the scan's type check — only the
+            # scan branch of `terms` hits this, i.e. the LARGE-T0 regime
+            # small-config tests never reach)
+            def zvar(a):
+                return jax.lax.pcast(a, SHARD_AXIS, to="varying")
+            acc = terms(zvar(jnp.zeros(x.shape, jnp.float64)), tags, T0)
             d = diag.reshape(diag.shape + (1,) * (x.ndim - 1))
             sc = (W * inv_n).reshape(inv_n.shape + (1,) * (x.ndim - 1))
             y = d * x + sc * acc
             if has_tail:
                 rows, tag_t = (a[0] for a in tail)
-                acc_t = terms(jnp.zeros(rows.shape + x.shape[1:]),
+                acc_t = terms(zvar(jnp.zeros(rows.shape + x.shape[1:])),
                               tag_t, tag_t.shape[0])
                 sct = W * inv_n[rows]
                 y = y.at[rows].add(
@@ -903,8 +911,9 @@ class DistributedEngine:
             if has_tail:
                 rows, idx_t, cf_t = (a[0] for a in tail)
                 zshape = rows.shape + x.shape[1:]
-                acc = terms(jnp.zeros(zshape, dtype), idx_t, cf_t,
-                            idx_t.shape[0])
+                acc = terms(jax.lax.pcast(jnp.zeros(zshape, dtype),
+                                          SHARD_AXIS, to="varying"),
+                            idx_t, cf_t, idx_t.shape[0])
                 y = y.at[rows].add(acc, mode="drop")
             return y[None]
 

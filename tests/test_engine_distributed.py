@@ -407,3 +407,22 @@ def test_multihost_two_process(tmp_path):
         assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
         if shards:      # the shard-native leg must actually have run
             assert f"[p{pid}] from_shards E0/4" in out, out[-2000:]
+
+
+@needs_8
+@pytest.mark.parametrize("mode", ["ell", "compact"])
+def test_distributed_scan_branch(mode, rng, monkeypatch):
+    """The lax.scan fallback of the term loops (taken only at LARGE T0,
+    where unrolling would blow the program) must agree with the host —
+    under shard_map the zero scan carries need varying-axes marking, which
+    the unrolled branch never exercises (chain_36-scale regression)."""
+    from distributed_matvec_tpu.parallel import distributed as dist_mod
+
+    monkeypatch.setattr(dist_mod, "unroll_terms_ok",
+                        lambda *a, **k: False)
+    op = build_heisenberg(12, 6, 1, [([*range(1, 12), 0], 0)])
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    eng = DistributedEngine(op, n_devices=8, mode=mode, batch_size=32)
+    np.testing.assert_allclose(eng.matvec_global(x), op.matvec_host(x),
+                               atol=ATOL, rtol=RTOL)
