@@ -32,7 +32,7 @@ from . import host as _host
 from ..utils.logging import log_debug
 
 __all__ = ["native_available", "enumerate_representatives_native",
-           "lookup_owners"]
+           "lookup_owners", "full_state_range", "rank_state_range"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_native.cpp")
@@ -150,6 +150,31 @@ def _ranges(lo: int, hi: int, hamming: Optional[int], n_chunks: int):
     return (np.array(starts, dtype=np.uint64), np.array(ends, dtype=np.uint64))
 
 
+def full_state_range(n_sites: int, hamming_weight: Optional[int]):
+    """[lo, hi] of the full candidate range for the sector."""
+    lo = (1 << hamming_weight) - 1 if hamming_weight else 0
+    hi = (lo << (n_sites - hamming_weight)) if hamming_weight \
+        else (1 << n_sites) - 1
+    if hamming_weight == 0:
+        lo = hi = 0
+    return lo, hi
+
+
+def rank_state_range(n_sites: int, hamming_weight: Optional[int],
+                     rank: int, n_ranks: int):
+    """Contiguous equal-index-work state range for one rank of ``n_ranks``
+    enumerating processes — the cross-process analog of the reference's
+    per-locale chunk assignment (StatesEnumeration.chpl:321-334), split in
+    fixed-hamming *index* space (determineEnumerationRanges, :94-113) so
+    every rank sees the same candidate count.  Returns None when the sector
+    has fewer candidates than ranks and this rank got nothing."""
+    lo, hi = full_state_range(n_sites, hamming_weight)
+    starts, ends = _ranges(lo, hi, hamming_weight, n_ranks)
+    if rank >= starts.size:
+        return None
+    return int(starts[rank]), int(ends[rank])
+
+
 def _stream_native(
     lib,
     n_sites: int,
@@ -159,16 +184,18 @@ def _stream_native(
     n_threads: Optional[int] = None,
     norm_tol: float = 1e-12,
     batch_tasks: int = 256,
+    state_range=None,
 ):
     """Generator over (states, norms) survivor slabs in ascending state
     order — the chunk ranges are disjoint and ascending, so concatenating
     the slabs (or routing them anywhere) preserves global sortedness.
-    Memory is bounded by one task batch's buffers."""
-    lo = (1 << hamming_weight) - 1 if hamming_weight else 0
-    hi = (lo << (n_sites - hamming_weight)) if hamming_weight \
-        else (1 << n_sites) - 1
-    if hamming_weight == 0:
-        lo = hi = 0
+    Memory is bounded by one task batch's buffers.
+
+    ``state_range=(lo, hi)`` restricts the scan to a sub-range (inclusive)
+    — the multi-process enumeration path hands each rank its own slice."""
+    lo, hi = full_state_range(n_sites, hamming_weight)
+    if state_range is not None:
+        lo, hi = int(state_range[0]), int(state_range[1])
 
     ls, rs, ms, xor, chr_ = _group_tables_cheap_first(group)
     G, S = ms.shape

@@ -39,7 +39,8 @@ from . import native as _native
 from .host import shard_index
 from ..utils.logging import log_debug
 
-__all__ = ["enumerate_to_shards", "load_shard", "shard_manifest"]
+__all__ = ["enumerate_to_shards", "load_shard", "shard_manifest",
+           "finalize_shard_parts"]
 
 _CHUNK = 1 << 20     # h5py append granularity (8 MB of u64)
 
@@ -67,6 +68,8 @@ def enumerate_to_shards(
     n_threads: Optional[int] = None,
     census_check: bool = True,
     flush_elems: int = 4 << 20,
+    rank: int = 0,
+    n_ranks: int = 1,
 ) -> dict:
     """Enumerate representatives of the sector straight into per-shard
     datasets at ``path`` (HDF5).  Returns the manifest dict
@@ -74,10 +77,30 @@ def enumerate_to_shards(
 
     Requires the native kernel (the pure-NumPy fallback would make the
     ≥10⁸-candidate configs this exists for intractable).
+
+    **Multi-process enumeration** (the analog of the reference's
+    per-locale concurrent enumeration, StatesEnumeration.chpl:321-334):
+    with ``n_ranks > 1`` this call enumerates only rank ``rank``'s
+    contiguous equal-index-work slice of the candidate space and writes it
+    to ``path.part<rank>``; every rank runs the same call concurrently
+    (separate processes), then ONE caller runs
+    :func:`finalize_shard_parts` to census-validate the union and write
+    the manifest at ``path``.  Because rank slices ascend and each rank's
+    shard stream is sorted, per-shard concatenation in rank order is
+    globally sorted — :func:`load_shard` does exactly that.
     """
     import h5py
 
+    if not (0 <= rank < n_ranks):
+        raise ValueError(f"rank {rank} outside 0..{n_ranks - 1}")
     fp = _fingerprint(n_sites, hamming_weight, group, n_shards, norm_tol)
+    state_range = None
+    if n_ranks > 1:
+        path = f"{path}.part{rank}"
+        fp = f"{fp}|part{rank}/{n_ranks}"
+        census_check = False     # only the union can be censused
+        state_range = _native.rank_state_range(
+            n_sites, hamming_weight, rank, n_ranks)
     if os.path.exists(path):
         man = shard_manifest(path)
         if man is not None and man.get("fingerprint") == fp:
@@ -131,10 +154,12 @@ def enumerate_to_shards(
             pending[d] = 0
 
         done = 0
-        for slab_s, slab_n in _native._stream_native(
+        slabs = () if (n_ranks > 1 and state_range is None) \
+            else _native._stream_native(
                 lib, n_sites, hamming_weight, group,
                 n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol,
-                batch_tasks=32):
+                batch_tasks=32, state_range=state_range)
+        for slab_s, slab_n in slabs:
             owner = shard_index(slab_s, D)
             # single-pass scatter: stable sort by owner keeps each shard's
             # slice in the slab's (ascending) state order
@@ -172,6 +197,9 @@ def enumerate_to_shards(
         f.attrs["n_sites"] = n_sites
         f.attrs["hamming_weight"] = -1 if hamming_weight is None \
             else int(hamming_weight)
+        if n_ranks > 1:
+            f.attrs["rank"] = rank
+            f.attrs["n_ranks"] = n_ranks
         # fingerprint LAST (same crash-consistency convention as the
         # engine-structure sidecars)
         f.attrs["fingerprint"] = fp
@@ -182,6 +210,70 @@ def enumerate_to_shards(
             "restored": False}
 
 
+def finalize_shard_parts(
+    n_sites: int,
+    hamming_weight: Optional[int],
+    group,
+    n_shards: int,
+    path: str,
+    n_ranks: int,
+    norm_tol: float = 1e-12,
+    census_check: bool = True,
+) -> dict:
+    """Combine ``n_ranks`` per-rank part files (from
+    :func:`enumerate_to_shards` with ``n_ranks > 1``) into a manifest at
+    ``path``.  Run by ONE process after every rank's part exists.
+
+    The manifest holds only counts/attrs and the part list — shard data
+    stays in the part files; :func:`load_shard` concatenates a shard's
+    slices in rank order (globally sorted by construction).  The union
+    total is validated against the sector-dimension census — the same
+    independent combinatorial cross-check the single-process path runs.
+    """
+    import h5py
+
+    fp = _fingerprint(n_sites, hamming_weight, group, n_shards, norm_tol)
+    man = shard_manifest(path)
+    if man is not None and man.get("fingerprint") == fp:
+        log_debug(f"sharded enumeration manifest restored from {path}")
+        return man
+    counts = np.zeros(n_shards, np.int64)
+    for r in range(n_ranks):
+        pman = shard_manifest(f"{path}.part{r}")
+        want_fp = f"{fp}|part{r}/{n_ranks}"
+        if pman is None or pman.get("fingerprint") != want_fp:
+            raise RuntimeError(
+                f"part file {path}.part{r} is missing or does not match "
+                "this sector/shard-count/rank-split — run every rank's "
+                "enumerate_to_shards first"
+            )
+        counts += np.asarray(pman["counts"], np.int64)
+    total = int(counts.sum())
+    if census_check:
+        want = group.sector_dimension_census(hamming_weight)
+        if total != want:
+            raise RuntimeError(
+                f"union of {n_ranks} enumeration parts holds {total} "
+                f"representatives but the sector-dimension census says "
+                f"{want} — a part is incomplete or ranks overlapped"
+            )
+    tmp = path + ".tmp"
+    with h5py.File(tmp, "w") as f:
+        f.attrs["n_shards"] = n_shards
+        f.attrs["counts"] = counts
+        f.attrs["total"] = total
+        f.attrs["n_sites"] = n_sites
+        f.attrs["hamming_weight"] = -1 if hamming_weight is None \
+            else int(hamming_weight)
+        f.attrs["parts"] = n_ranks
+        f.attrs["fingerprint"] = fp
+    os.replace(tmp, path)
+    log_debug(f"sharded enumeration: combined {n_ranks} parts, {total} "
+              f"representatives in {n_shards} shards at {path}")
+    return {"counts": counts.tolist(), "total": total, "fingerprint": fp,
+            "n_shards": n_shards, "parts": n_ranks, "restored": False}
+
+
 def shard_manifest(path: str) -> Optional[dict]:
     """Counts/total/fingerprint of a shard file, or None if unreadable."""
     import h5py
@@ -190,20 +282,35 @@ def shard_manifest(path: str) -> Optional[dict]:
         with h5py.File(path, "r") as f:
             if "fingerprint" not in f.attrs:
                 return None
-            return {"counts": list(map(int, f.attrs["counts"])),
-                    "total": int(f.attrs["total"]),
-                    "n_shards": int(f.attrs["n_shards"]),
-                    "fingerprint": str(f.attrs["fingerprint"]),
-                    "restored": True}
+            man = {"counts": list(map(int, f.attrs["counts"])),
+                   "total": int(f.attrs["total"]),
+                   "n_shards": int(f.attrs["n_shards"]),
+                   "fingerprint": str(f.attrs["fingerprint"]),
+                   "restored": True}
+            if "parts" in f.attrs:
+                man["parts"] = int(f.attrs["parts"])
+            return man
     except OSError:
         return None
 
 
 def load_shard(path: str, d: int):
     """(representatives, norms) of one shard — sorted ascending; only this
-    shard's data is read into memory."""
+    shard's data is read into memory.  For a multi-process manifest the
+    shard is the rank-order concatenation of the part files' slices
+    (sorted because rank state-ranges ascend)."""
     import h5py
 
     with h5py.File(path, "r") as f:
-        g = f["shards"][str(d)]
-        return g["representatives"][...], g["norms"][...]
+        if "parts" in f.attrs:
+            n_ranks = int(f.attrs["parts"])
+        else:
+            g = f["shards"][str(d)]
+            return g["representatives"][...], g["norms"][...]
+    reps, norms = [], []
+    for r in range(n_ranks):
+        with h5py.File(f"{path}.part{r}", "r") as f:
+            g = f["shards"][str(d)]
+            reps.append(g["representatives"][...])
+            norms.append(g["norms"][...])
+    return np.concatenate(reps), np.concatenate(norms)

@@ -102,29 +102,62 @@ def save_hashed_vector(path: str, xh, counts, name: str = "v") -> None:
     import h5py
     import jax
 
+    import os
+    import tempfile
+
     counts = np.asarray(counts, np.int64)
     D = counts.size
     if jax.process_count() > 1:
         path = f"{path}.r{jax.process_index()}"
-    with h5py.File(path, "a") as f:
-        g = f.require_group(f"vector_shards/{name}")
-        for d in range(D):
-            shard = None
-            if isinstance(xh, jax.Array):
-                for piece in xh.addressable_shards:
-                    if piece.index[0].start == d:
-                        shard = np.asarray(piece.data)[0]
-                        break
-                if shard is None:
-                    continue            # another process's shard
-            else:
-                shard = np.asarray(xh)[d]
-            key = str(d)
-            if key in g:
-                del g[key]
-            g.create_dataset(key, data=shard[: counts[d]])
-        f.attrs["counts"] = counts
-        f.attrs["n_shards"] = D
+    # Atomic write (matching save_engine_structure / enumerate_to_shards):
+    # build the whole file at a temp path and os.replace it, so a crash
+    # mid-save can't leave a corrupt or mixed-generation vector file, and
+    # the `name` group is recreated wholesale so stale shard datasets from
+    # an earlier save with a different D/counts can't survive.  Other
+    # vector groups already in the file are carried over.
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp")
+    os.close(fd)
+    try:
+        with h5py.File(tmp, "w") as fout:
+            if os.path.exists(path):
+                # carry over EVERYTHING except the group being rewritten:
+                # other vector groups, co-located datasets/groups (e.g. an
+                # enumeration 'shards' tree), and root attrs.  An unreadable
+                # previous file is an error — silently replacing it would
+                # destroy co-located data the caller never asked us to touch.
+                with h5py.File(path, "r") as fin:
+                    for k in fin:
+                        if k == "vector_shards":
+                            dst = fout.require_group("vector_shards")
+                            for other in fin["vector_shards"]:
+                                if other != name:
+                                    fin.copy(f"vector_shards/{other}", dst,
+                                             name=other)
+                        else:
+                            fin.copy(k, fout, name=k)
+                    for k, v in fin.attrs.items():
+                        if k not in ("counts", "n_shards"):
+                            fout.attrs[k] = v
+            g = fout.require_group(f"vector_shards/{name}")
+            for d in range(D):
+                shard = None
+                if isinstance(xh, jax.Array):
+                    for piece in xh.addressable_shards:
+                        if piece.index[0].start == d:
+                            shard = np.asarray(piece.data)[0]
+                            break
+                    if shard is None:
+                        continue            # another process's shard
+                else:
+                    shard = np.asarray(xh)[d]
+                g.create_dataset(str(d), data=shard[: counts[d]])
+            fout.attrs["counts"] = counts
+            fout.attrs["n_shards"] = D
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:

@@ -216,7 +216,14 @@ class DistributedEngine:
 
         b = min(batch_size or cfg.matvec_batch_size, M)
         self.batch_size = _round_up(min(b, M), 8)
-        self._checked = False
+        # Overflow/invalid counters are validated once per compiled program
+        # (keyed by row-chunk size B): fused wide batches compile shrunk-B
+        # programs with proportionally shrunk all_to_all capacity, and a
+        # shrunk program can overflow where the base one didn't (higher
+        # relative bucket skew), so a single global flag is not enough.
+        self._checked: set = set()
+        self._last_program_key = None
+        self._last_capacity: Optional[int] = None
 
         if mode in ("ell", "compact"):
             # the routing-plan build cross-searches every peer's rows, so
@@ -243,7 +250,7 @@ class DistributedEngine:
                     self._build_plan(alphas_h, norms_h)
                 self._save_structure(structure_cache)
             self._matvec = self._make_ell_matvec()
-            self._checked = True
+            self._checked.add(None)  # static plan: no data-dependent capacity
         elif mode == "compact":
             self.structure_restored = self._try_load_structure(
                 structure_cache, norms_h=norms_h)
@@ -253,7 +260,7 @@ class DistributedEngine:
                 self._save_structure(structure_cache)
                 self._c_n_all = None   # only needed by the save just done
             self._matvec = self._make_compact_matvec()
-            self._checked = True
+            self._checked.add(None)  # static plan: no data-dependent capacity
         else:
             # Per-shard bucketed lookup over each shard's REAL prefix
             # (SENTINEL pads sort last, so real entries are alphas[d][:count]
@@ -591,6 +598,14 @@ class DistributedEngine:
                         n_all_d[M + p * C: M + p * C + q.size] = \
                             norms_h[p][q]
                 n_all_shards.append(n_all_d)
+        if compact and jax.process_count() > 1:
+            # badw is accumulated over THIS process's addressable shards
+            # only; agree on the total before raising so a non-qualifying
+            # operator fails loudly on every rank instead of hanging the
+            # others in the next collective
+            from jax.experimental import multihost_utils
+            badw = int(np.sum(multihost_utils.process_allgather(
+                np.int64(badw))))
         if badw:
             raise RuntimeError(
                 f"{badw} matrix elements violate the ±W·n(j)/n(i) form "
@@ -1089,6 +1104,7 @@ class DistributedEngine:
         self._operands = (self._alphas, self._norms, self._diag, self.tables,
                           self._lk_pair, self._lk_dir)
         programs = {base_B: jax.jit(apply_fn)}
+        capacities = {base_B: self._capacity}
 
         def run(x):
             # Batches ride the same program: the routing (hash/argsort/
@@ -1103,8 +1119,12 @@ class DistributedEngine:
             B = base_B if k <= 4 else min(
                 base_B, _round_up(max(8, (4 * base_B) // k), 8))
             if B not in programs:
-                programs[B] = jax.jit(
-                    make_program(B, self._fused_capacity(B)))
+                capacities[B] = self._fused_capacity(B)
+                programs[B] = jax.jit(make_program(B, capacities[B]))
+            # matvec() validates counters once per program key, with THIS
+            # program's capacity in any overflow report
+            self._last_program_key = B
+            self._last_capacity = capacities[B]
             return programs[B](x, self._operands)
 
         return run
@@ -1171,19 +1191,22 @@ class DistributedEngine:
                     f"[D, M, k, 2] (re, im) f64 vectors, got {xh.shape}"
                 )
             y, overflow, invalid = self._matvec(xh)
-            if check or (check is None and not self._checked):
+            key = self._last_program_key
+            if check or (check is None and key not in self._checked):
                 if int(overflow):
+                    cap = (self._last_capacity if self._last_capacity
+                           is not None else getattr(self, "_capacity", None))
                     raise RuntimeError(
                         f"{int(overflow)} amplitudes overflowed the all_to_all "
-                        f"capacity {self._capacity}; raise remote_buffer_size "
-                        "or all_to_all_capacity_factor"
+                        f"capacity {cap} (program chunk {key}); raise "
+                        "remote_buffer_size or all_to_all_capacity_factor"
                     )
                 if int(invalid):
                     raise RuntimeError(
                         f"{int(invalid)} generated amplitudes map outside the "
                         "basis — operator does not preserve the chosen sector"
                     )
-                self._checked = True
+                self._checked.add(key)
         return y
 
     def matvec_global(self, x) -> np.ndarray:

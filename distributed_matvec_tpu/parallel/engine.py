@@ -154,18 +154,23 @@ def unroll_terms_ok(width: int, rows: int, x_shape=()) -> bool:
     return width <= 64 and width * rows * vec_width * 20 <= 2_000_000_000
 
 
-def hash_basis_operator(h, operator) -> None:
+def hash_basis_operator(h, operator, include_arrays: bool = True) -> None:
     """Feed everything that identifies a (basis, operator) pair into a hash:
     the basis JSON, the ACTUAL representative/norm arrays (they may have been
     restored rather than enumerated), and the nonbranching term tables.
-    Shared by both engines' structure fingerprints so they cannot drift."""
+    Shared by both engines' structure fingerprints so they cannot drift.
+
+    ``include_arrays=False`` skips the representative/norm arrays — the
+    shard-native-safe form (those engines never materialize the global
+    basis) used to key mid-solve checkpoints by the *problem* alone."""
     import json as _json
 
     basis = operator.basis
     h.update(_json.dumps(basis._json_dict(), sort_keys=True,
                          default=str).encode())
-    h.update(np.ascontiguousarray(basis.representatives).tobytes())
-    h.update(np.ascontiguousarray(basis.norms).tobytes())
+    if include_arrays:
+        h.update(np.ascontiguousarray(basis.representatives).tobytes())
+        h.update(np.ascontiguousarray(basis.norms).tobytes())
     dt, ot = operator.diag_table, operator.off_diag_table
     for a in (dt.v, dt.s, dt.m, dt.r, ot.x, ot.v, ot.s, ot.m, ot.r):
         h.update(np.ascontiguousarray(a).tobytes())

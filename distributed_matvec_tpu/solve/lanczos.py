@@ -72,6 +72,26 @@ class LanczosResult:
         return 0.0
 
 
+def _operator_key(owner) -> str:
+    """Hash of the (basis, operator) pair behind an engine's matvec, used to
+    key mid-solve checkpoints.  Delegates to the engines' shared
+    ``hash_basis_operator`` with ``include_arrays=False`` (basis JSON +
+    nonbranching term tables — everything that determines H as a matrix —
+    but not the representative arrays, so shard-native engines whose basis
+    is never materialized globally get the same key as a global build of
+    the same problem).  Returns ``"bare"`` for non-engine callables."""
+    op = getattr(owner, "operator", None)
+    if op is None:
+        return "bare"
+    import hashlib
+
+    from ..parallel.engine import hash_basis_operator
+
+    h = hashlib.sha256()
+    hash_basis_operator(h, op, include_arrays=False)
+    return h.hexdigest()[:16]
+
+
 def _rand_like(shape, dtype, seed):
     rng = np.random.default_rng(seed)
     v = rng.standard_normal(shape)
@@ -239,10 +259,13 @@ def lanczos(
     block boundary the live Krylov basis + recurrence state are written
     atomically, and a rerun with the same path, operator, and solver
     geometry resumes where it left off.  The checkpoint is keyed by the
-    vector shape/dtype and solver geometry; pointing it at a DIFFERENT
-    operator with the same geometry is the caller's responsibility (pass a
-    fresh path per problem).  Single-controller only (the basis fetch is a
-    global read); ignored with a debug log in multi-process runs.
+    vector shape/dtype AND, when an engine is behind ``matvec``, by the
+    operator itself (basis JSON + term tables), so a rerun against an
+    edited Hamiltonian of the same size starts fresh instead of restoring
+    a foreign Krylov state.  Bare callables are keyed by shape only —
+    there, a fresh path per problem remains the caller's responsibility.
+    Single-controller only (the basis fetch is a global read); ignored
+    with a debug log in multi-process runs.
     """
     # Engines expose (apply_fn, operands) so the block runner can pass the
     # matrix tables as jit arguments; plain callables fall back to empty
@@ -313,10 +336,16 @@ def lanczos(
     converged = False
     theta = S = res = None
 
-    # keyed by the vector space only — NOT by solver geometry, so a rerun
-    # with a different max_iters / basis bound still resumes (the saved
-    # rows are valid in any buffer that fits them)
-    ckpt_fp = f"{tuple(shape)}|{np.dtype(dtype).str}|lanczos-v1"
+    # keyed by the vector space AND (when an engine is behind the matvec)
+    # the operator itself — NOT by solver geometry, so a rerun with a
+    # different max_iters / basis bound still resumes (the saved rows are
+    # valid in any buffer that fits them), but a rerun against an EDITED
+    # Hamiltonian with the same lattice size (same shape) refuses the
+    # foreign Krylov state instead of silently restoring it.  Bare
+    # callables fall back to shape-only keying (documented caller
+    # responsibility).
+    ckpt_fp = f"{tuple(shape)}|{np.dtype(dtype).str}|{_operator_key(owner)}" \
+        "|lanczos-v2"
     resumed_from = 0
     if checkpoint_path and jax.process_count() > 1:
         from ..utils.logging import log_debug

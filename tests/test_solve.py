@@ -209,6 +209,46 @@ def test_lanczos_checkpoint_resume(tmp_path):
                                np.linalg.eigvalsh(B)[0], atol=1e-8)
 
 
+def test_lanczos_checkpoint_keyed_by_operator(tmp_path):
+    """An engine-backed solve keys its checkpoint by the operator: a rerun
+    against an EDITED Hamiltonian with the same lattice (same vector shape)
+    must MISS the foreign Krylov state and converge to the new operator's
+    ground state, not silently restore the old one (ADVICE r3)."""
+    from test_operator import build_heisenberg
+    from distributed_matvec_tpu.models.yaml_io import operator_from_dict
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    op1 = build_heisenberg(10, 5)
+    op1.basis.build()
+    eng1 = LocalEngine(op1)
+    ck = str(tmp_path / "lz.h5")
+    r1 = lanczos(eng1.matvec, op1.basis.number_states, k=1, tol=1e-11,
+                 max_iters=24, check_every=8, checkpoint_path=ck,
+                 checkpoint_every=1)
+    assert not r1.converged
+
+    # the SAME operator rebuilt from scratch resumes (fingerprint is a pure
+    # function of the problem, not the object identity)
+    op1b = build_heisenberg(10, 5)
+    op1b.basis.build()
+    r3 = lanczos(LocalEngine(op1b).matvec, op1b.basis.number_states, k=1,
+                 tol=1e-11, max_iters=24, check_every=8, checkpoint_path=ck)
+    assert r3.resumed_from == 24
+
+    # same basis, different couplings → same shape, different operator
+    ham2 = {"terms": [{"expression": "2.5 σᶻ₀ σᶻ₁ + σˣ₀ σˣ₁ + σʸ₀ σʸ₁",
+                       "sites": [[i, (i + 1) % 10] for i in range(10)]}]}
+    b2 = type(op1.basis)(number_spins=10, hamming_weight=5)
+    op2 = operator_from_dict(ham2, b2)
+    op2.basis.build()
+    eng2 = LocalEngine(op2)
+    r2 = lanczos(eng2.matvec, op2.basis.number_states, k=1, tol=1e-10,
+                 max_iters=300, check_every=8, checkpoint_path=ck)
+    assert r2.resumed_from == 0              # foreign state refused
+    want2 = np.linalg.eigvalsh(op2.to_sparse().toarray())[0]
+    np.testing.assert_allclose(r2.eigenvalues[0], want2, atol=1e-8)
+
+
 def test_lanczos_checkpoint_resume_restart_boundary(tmp_path):
     """Resume across a thick-restart boundary: the checkpoint written after
     a restart carries the arrowhead (lock) state and still converges to
