@@ -63,9 +63,9 @@ def main(argv=None):
     ap.add_argument("--shards", default=None, metavar="SHARDS_H5",
                     help="construct the engine from a sharded-enumeration "
                          "file (tools/sharded_enum_scale.py) — the global "
-                         "representative array is never built; implies a "
-                         "hashed-space solve (pair with --no-eigenvectors "
-                         "at large scale)")
+                         "representative array is never built; the solve "
+                         "stays in hashed space and eigenvectors are saved "
+                         "per shard (vector_shards/eigenvector_<i>)")
     ap.add_argument("--mode", choices=("ell", "compact", "fused"),
                     default=None,
                     help="engine mode: precomputed structure (ell, the "
@@ -201,36 +201,88 @@ def main(argv=None):
           f"({niter / max(dt, 1e-9):.2f} iters/s)")
 
     evec_rows = None
+    evecs_hashed = None
+    is_pair = bool(getattr(eng, "pair", False))
+    hashed_ndim = 3 if is_pair else 2       # [D, M(, 2)] hashed layout
     if evecs is not None and not args.no_eigenvectors:
-        is_pair = bool(getattr(eng, "pair", False))
-        hashed_ndim = 3 if is_pair else 2   # [D, M(, 2)] hashed layout
-        rows = []
-        for v in evecs[: args.num_evals]:
-            # hashed → block order for I/O BEFORE any host fetch: in a
-            # multi-controller run the hashed array spans other processes'
-            # devices and from_hashed allgathers it
-            if hasattr(eng, "from_hashed") and np.ndim(v) == hashed_ndim:
-                v = eng.from_hashed(v)
-            v = np.asarray(v)
-            if is_pair and not np.iscomplexobj(v):
-                # (re, im) pair → complex for I/O (LOBPCG already
-                # returns complex columns)
-                from distributed_matvec_tpu.ops.kernels import (
-                    complex_from_pair)
-                v = complex_from_pair(v)
-            rows.append(v)
-        evec_rows = np.stack(rows)
+        if args.shards and all(np.ndim(v) == hashed_ndim
+                               for v in evecs[: args.num_evals]):
+            # shard-native solve: eigenvectors stay hashed and are saved
+            # one shard at a time with pads stripped (the per-locale block
+            # writes of MyHDF5.chpl:272-333) — no global [N] array is ever
+            # materialized, so --shards no longer needs --no-eigenvectors
+            evecs_hashed = evecs[: args.num_evals]
+        else:
+            rows = []
+            for v in evecs[: args.num_evals]:
+                # hashed → block order for I/O BEFORE any host fetch: in a
+                # multi-controller run the hashed array spans other
+                # processes' devices and from_hashed allgathers it
+                if hasattr(eng, "from_hashed") and np.ndim(v) == hashed_ndim:
+                    v = eng.from_hashed(v)
+                v = np.asarray(v)
+                if is_pair and not np.iscomplexobj(v):
+                    # (re, im) pair → complex for I/O (LOBPCG already
+                    # returns complex columns)
+                    from distributed_matvec_tpu.ops.kernels import (
+                        complex_from_pair)
+                    v = complex_from_pair(v)
+                rows.append(v)
+            evec_rows = np.stack(rows)
 
     with timer.scope("save"):
         if rank0:
             save_eigen(out, np.asarray(evals), evec_rows,
                        np.asarray(residuals))
+        if evecs_hashed is not None:
+            # every rank writes its addressable shards (the save targets
+            # out.r<rank> in multi-process runs); pair-mode vectors keep
+            # the (re, im) trailing axis on disk; one file pass for all k
+            from distributed_matvec_tpu.io.sharded_io import (
+                save_hashed_vectors)
+            save_hashed_vectors(
+                out, {f"eigenvector_{i}": v
+                      for i, v in enumerate(evecs_hashed)}, eng.counts)
 
     for i, (w, r) in enumerate(zip(np.atleast_1d(evals),
                                    np.atleast_1d(residuals))):
         print(f"  E[{i}] = {w:.12f}   residual {r:.2e}")
 
-    if args.observables and cfg.observables and evec_rows is not None:
+    if args.observables and cfg.observables and evecs_hashed is not None:
+        # Shard-native observables: |ψ₀⟩ never leaves the hashed space.
+        # Every observable engine shares H's mesh and hash layout (pure
+        # functions of the basis + device count), so the hashed ψ is
+        # directly consumable — no block-order psi, no layout
+        # materialization, no global array at any point.
+        from distributed_matvec_tpu.io.hdf5 import save_observables
+        from distributed_matvec_tpu.parallel.distributed import (
+            DistributedEngine)
+        import jax.numpy as jnp
+
+        psi_h = evecs_hashed[0]
+
+        def expectation_hashed(obs):
+            oeng = DistributedEngine.from_shards(
+                obs, args.shards, mesh=eng.mesh, mode="fused")
+            if is_pair or not oeng.pair:
+                # same form either way, or pair ψ [D, M, 2] into a
+                # REAL-sector engine: the trailing (re, im) axis is exactly
+                # a 2-column real batch, and the summed batch dot is
+                # Re†O·Re + Im†O·Im — the full ψ†Oψ for real Hermitian O
+                # (cross terms cancel)
+                xh = psi_h
+            else:
+                # real ψ into a complex-sector (pair) engine: zero imag
+                xh = jnp.stack([psi_h, jnp.zeros_like(psi_h)], axis=-1)
+            return float(np.real(complex(oeng.dot(xh, oeng.matvec(xh)))))
+
+        with timer.scope("observables"):
+            values = [(obs.name or f"observable_{k}", expectation_hashed(obs))
+                      for k, obs in enumerate(cfg.observables)]
+        if rank0:
+            for name, val in save_observables(out, values).items():
+                print(f"  <{name}> = {val:.12f}")
+    elif args.observables and cfg.observables and evec_rows is not None:
         # ⟨ψ₀|O|ψ₀⟩ per observable, printed and saved under /observables —
         # the output group the reference driver creates (Diagonalize.chpl:276-279).
         # Each observable gets its own *fused-mode* engine: no structure

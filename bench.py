@@ -213,24 +213,69 @@ def main():
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the device liveness probe")
     ap.add_argument("--cpu-fallback", action="store_true",
-                    help=argparse.SUPPRESS)   # set only by the re-exec below
+                    help="run the full CPU-feasible config matrix on the "
+                         "CPU backend (what a failed device probe degrades "
+                         "to automatically)")
     args = ap.parse_args()
 
     # Full runs target the accelerator, which can be wedged — probe first and
-    # degrade to a marked CPU smoke run rather than hanging the driver.
-    # --smoke is CPU-safe by construction and skips the probe.
-    if not args.smoke and not args.no_probe and not _probe_device():
-        _progress("falling back to CPU smoke run")
+    # degrade to a marked CPU fallback run rather than hanging the driver.
+    if (not args.smoke and not args.cpu_fallback and not args.no_probe
+            and not _probe_device()):
+        _progress("falling back to a CPU run of the full small-config matrix")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__), "--smoke",
+                  [sys.executable, os.path.abspath(__file__),
                    "--cpu-fallback"], env)
+
+    if args.smoke or args.cpu_fallback:
+        # The env var alone is not enough on this image: the accelerator
+        # plugin's sitecustomize can force its platform through jax.config
+        # at interpreter start, and backend init then hangs on the dead
+        # tunnel — pin the CPU platform explicitly before any backend touch.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     detail = {}
     if args.smoke:
         main_cfg = _bench_config(
             "heisenberg_chain_16", dict(number_spins=16, hamming_weight=8),
             repeats=5, host_repeats=1, solver_iters=20)
+    elif args.cpu_fallback:
+        # Dead-chip round: run every config that is CPU-feasible (same
+        # config keys as the recorded full run, minus chain_32_symm whose
+        # structure build alone costs tens of minutes on one host core) so
+        # the round's artifact stays comparable instead of near-empty.
+        for key, cfg_args, kw in (
+            ("chain_16", dict(number_spins=16, hamming_weight=8),
+             dict(repeats=5, host_repeats=1, solver_iters=20)),
+            ("chain_20", dict(number_spins=20, hamming_weight=10),
+             dict(repeats=5, host_repeats=1, solver_iters=50)),
+            ("kagome_16", dict(number_spins=16, hamming_weight=8),
+             dict(repeats=5, host_repeats=1, solver_iters=60, edges="kagome")),
+            ("square_4x4", dict(number_spins=16, hamming_weight=8),
+             dict(repeats=5, host_repeats=1, solver_iters=0, edges="square")),
+        ):
+            try:
+                edges = kw.pop("edges", None)
+                if edges == "kagome":
+                    from distributed_matvec_tpu.models.lattices import (
+                        kagome_16_edges)
+                    kw["edges"] = kagome_16_edges()
+                elif edges == "square":
+                    from distributed_matvec_tpu.models.lattices import (
+                        square_edges)
+                    kw["edges"] = square_edges(4, 4)
+                detail[key] = _bench_config(f"heisenberg_{key}", cfg_args,
+                                            **kw)
+            except Exception as e:
+                detail[key] = {"error": repr(e)}
+        try:
+            main_cfg = _bench_config(
+                "heisenberg_chain_24_symm", CHAIN_24_SYMM,
+                repeats=5, host_repeats=1, solver_iters=30)
+        except Exception as e:
+            main_cfg = dict(detail.get("chain_20") or {}, error=repr(e))
     else:
         try:
             detail["chain_20"] = _bench_config(
@@ -278,8 +323,10 @@ def main():
     }
     if args.cpu_fallback:
         line["cpu_fallback"] = True
-        line["note"] = ("accelerator unreachable at bench time; CPU smoke "
-                        "numbers — see README for the recorded TPU results")
+        line["note"] = ("accelerator unreachable at bench time; CPU numbers "
+                        "for the full small-config matrix (chain_32_symm "
+                        "omitted — CPU-infeasible) — see README for the "
+                        "recorded TPU results")
     print(json.dumps(line))
     return 0
 

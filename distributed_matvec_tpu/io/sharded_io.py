@@ -33,7 +33,8 @@ import numpy as np
 from ..enumeration.host import shard_index
 
 __all__ = ["stream_block_to_shards", "save_hashed_vector",
-           "load_hashed_shard", "hashed_vector_counts"]
+           "save_hashed_vectors", "load_hashed_shard",
+           "hashed_vector_counts"]
 
 _CHUNK = 1 << 20
 
@@ -99,39 +100,45 @@ def save_hashed_vector(path: str, xh, counts, name: str = "v") -> None:
     HDF5 has no concurrent-writer support, so in a multi-process run each
     rank writes its OWN file (``path.r<rank>``); :func:`load_hashed_shard`
     finds a shard in whichever file holds it."""
-    import h5py
-    import jax
+    save_hashed_vectors(path, {name: xh}, counts)
 
+
+def save_hashed_vectors(path: str, vectors: dict, counts) -> None:
+    """Write several named hashed arrays in ONE atomic file pass — the
+    rewrite cost is paid once, not once per vector (a k-eigenvector save
+    would otherwise re-copy all earlier vectors k times).
+
+    Atomic write (matching save_engine_structure / enumerate_to_shards):
+    the whole file is built at a temp path and ``os.replace``d, so a crash
+    mid-save can't leave a corrupt or mixed-generation vector file, and
+    each rewritten group is recreated wholesale so stale shard datasets
+    from an earlier save with a different D/counts can't survive.  All
+    other file content (other vector groups, co-located datasets/groups,
+    root attrs) is carried over; an unreadable previous file is an error —
+    silently replacing it would destroy co-located data the caller never
+    asked us to touch."""
     import os
     import tempfile
+
+    import h5py
+    import jax
 
     counts = np.asarray(counts, np.int64)
     D = counts.size
     if jax.process_count() > 1:
         path = f"{path}.r{jax.process_index()}"
-    # Atomic write (matching save_engine_structure / enumerate_to_shards):
-    # build the whole file at a temp path and os.replace it, so a crash
-    # mid-save can't leave a corrupt or mixed-generation vector file, and
-    # the `name` group is recreated wholesale so stale shard datasets from
-    # an earlier save with a different D/counts can't survive.  Other
-    # vector groups already in the file are carried over.
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp")
     os.close(fd)
     try:
         with h5py.File(tmp, "w") as fout:
             if os.path.exists(path):
-                # carry over EVERYTHING except the group being rewritten:
-                # other vector groups, co-located datasets/groups (e.g. an
-                # enumeration 'shards' tree), and root attrs.  An unreadable
-                # previous file is an error — silently replacing it would
-                # destroy co-located data the caller never asked us to touch.
                 with h5py.File(path, "r") as fin:
                     for k in fin:
                         if k == "vector_shards":
                             dst = fout.require_group("vector_shards")
                             for other in fin["vector_shards"]:
-                                if other != name:
+                                if other not in vectors:
                                     fin.copy(f"vector_shards/{other}", dst,
                                              name=other)
                         else:
@@ -139,19 +146,20 @@ def save_hashed_vector(path: str, xh, counts, name: str = "v") -> None:
                     for k, v in fin.attrs.items():
                         if k not in ("counts", "n_shards"):
                             fout.attrs[k] = v
-            g = fout.require_group(f"vector_shards/{name}")
-            for d in range(D):
-                shard = None
-                if isinstance(xh, jax.Array):
-                    for piece in xh.addressable_shards:
-                        if piece.index[0].start == d:
-                            shard = np.asarray(piece.data)[0]
-                            break
-                    if shard is None:
-                        continue            # another process's shard
-                else:
-                    shard = np.asarray(xh)[d]
-                g.create_dataset(str(d), data=shard[: counts[d]])
+            for name, xh in vectors.items():
+                g = fout.require_group(f"vector_shards/{name}")
+                for d in range(D):
+                    shard = None
+                    if isinstance(xh, jax.Array):
+                        for piece in xh.addressable_shards:
+                            if piece.index[0].start == d:
+                                shard = np.asarray(piece.data)[0]
+                                break
+                        if shard is None:
+                            continue        # another process's shard
+                    else:
+                        shard = np.asarray(xh)[d]
+                    g.create_dataset(str(d), data=shard[: counts[d]])
             fout.attrs["counts"] = counts
             fout.attrs["n_shards"] = D
         os.replace(tmp, path)

@@ -150,7 +150,14 @@ def unroll_terms_ok(width: int, rows: int, x_shape=()) -> bool:
     every gather output.  Beyond ~2 GB of estimated scratch, ``lax.scan``
     serializes the terms: same math, one term's scratch at a time.
     """
+    from ..utils.config import get_config
+
+    form = get_config().term_loop
+    if form == "scan":
+        return False
     vec_width = int(np.prod(x_shape[1:], dtype=np.int64)) or 1
+    if form == "unroll":
+        return width <= 64
     return width <= 64 and width * rows * vec_width * 20 <= 2_000_000_000
 
 

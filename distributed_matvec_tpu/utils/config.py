@@ -46,6 +46,12 @@ class RuntimeConfig:
     #   "compact" (4 B/entry, isotropic real sectors) | "fused" (recompute)
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
+    term_loop: str = "auto"                # ELL/compact per-term loop form:
+    #   auto (unroll until the estimated gather scratch would exceed ~2 GB,
+    #   then lax.scan — see engine.unroll_terms_ok) | scan (force the
+    #   serialized low-memory form everywhere) | unroll (force concurrent
+    #   gathers whenever width permits).  "scan" lets small configs exercise
+    #   the large-T0 code path the big bases take.
     complex_pair: str = "auto"             # (re,im)-f64 pair engines for
     #   complex sectors: auto | on | off.  auto = pair form on the TPU
     #   backend (whose compiler cannot handle complex128 — see below),

@@ -1,4 +1,4 @@
-"""Independent σ-Heisenberg ring reference — shares NOTHING with the package.
+"""Independent σ-Heisenberg reference — shares NOTHING with the package.
 
 The golden harness above 12 sites previously checked engine-vs-matvec_host,
 both of which consume ``models/expression.py``'s term tables; a bug in the
@@ -7,8 +7,13 @@ definition directly — pure NumPy bit operations, no expression parsing, no
 term tables, no hashing — the same independence role the reference's
 OpenMP-generated goldens play (SURVEY.md §4, input_for_matvec.py).
 
+Works for ANY bond list (rings, squares, kagome, …): the edge list is part
+of the problem *specification* (shared with the engine exactly as the
+reference shares its YAML), while everything derived from it — matrix
+elements, indices, signs — is computed here from the definition alone.
+
 Convention matches the package's YAML models: σ-form Pauli matrices (4× the
-spin-1/2 S-form), H = Σ_⟨ij⟩ σˣᵢσˣⱼ + σʸᵢσʸⱼ + σᶻᵢσᶻⱼ over ring bonds:
+spin-1/2 S-form), H = Σ_⟨ij⟩ σˣᵢσˣⱼ + σʸᵢσʸⱼ + σᶻᵢσᶻⱼ over the bonds:
   * σᶻᵢσᶻⱼ |s⟩ = ±|s⟩  (+ if bits i, j equal, − otherwise)
   * (σˣᵢσˣⱼ + σʸᵢσʸⱼ) |s⟩ = 2·|s with bits i, j swapped⟩ if they differ,
     else 0.
@@ -28,13 +33,13 @@ def enumerate_fixed_hw(n: int, hw: int) -> np.ndarray:
     return np.sort(states)
 
 
-def heisenberg_ring_apply(states: np.ndarray, n: int,
-                          x: np.ndarray) -> np.ndarray:
-    """y = H·x on the fixed-hw sector spanned by sorted ``states``."""
+def heisenberg_apply(states: np.ndarray, edges, x: np.ndarray) -> np.ndarray:
+    """y = H·x on the fixed-hw sector spanned by sorted ``states``, for an
+    arbitrary bond list ``edges`` (pairs may repeat — each occurrence is a
+    physical coupling, e.g. doubled wrap bonds on a width-2 torus)."""
     y = np.zeros_like(x, dtype=np.float64)
     s = states
-    for i in range(n):
-        j = (i + 1) % n
+    for i, j in edges:
         bi = (s >> np.uint64(i)) & np.uint64(1)
         bj = (s >> np.uint64(j)) & np.uint64(1)
         differ = bi != bj
@@ -48,18 +53,30 @@ def heisenberg_ring_apply(states: np.ndarray, n: int,
     return y
 
 
-def ring_ground_energy(n: int, hw: int, tol: float = 1e-12):
-    """Lowest eigenvalue of the full fixed-hw sector via ARPACK over the
-    independent apply (the ground state of the bipartite ring lives in the
-    fully symmetric momentum/parity/inversion sector, so this also pins the
-    *_symm configs' E0)."""
+def heisenberg_ring_apply(states: np.ndarray, n: int,
+                          x: np.ndarray) -> np.ndarray:
+    """y = H·x for the n-site periodic ring (edge-list special case)."""
+    return heisenberg_apply(states, [(i, (i + 1) % n) for i in range(n)], x)
+
+
+def ground_energy(n: int, hw: int, edges, tol: float = 1e-12, k: int = 1):
+    """Lowest eigenvalue(s) of the full fixed-hw sector via ARPACK over the
+    independent apply."""
     from scipy.sparse.linalg import LinearOperator, eigsh
 
     states = enumerate_fixed_hw(n, hw)
     N = states.size
     op = LinearOperator(
-        (N, N), matvec=lambda v: heisenberg_ring_apply(states, n, v),
+        (N, N), matvec=lambda v: heisenberg_apply(states, edges, v),
         dtype=np.float64)
-    w = eigsh(op, k=1, which="SA", tol=tol,
-              return_eigenvectors=False)
-    return float(w[0]), states
+    w = eigsh(op, k=k, which="SA", tol=tol, return_eigenvectors=False)
+    w = np.sort(w)
+    return (float(w[0]) if k == 1 else w), states
+
+
+def ring_ground_energy(n: int, hw: int, tol: float = 1e-12):
+    """Ring special case of :func:`ground_energy` (the ground state of the
+    bipartite ring lives in the fully symmetric momentum/parity/inversion
+    sector, so this also pins the *_symm configs' E0)."""
+    return ground_energy(n, hw, [(i, (i + 1) % n) for i in range(n)],
+                         tol=tol)
