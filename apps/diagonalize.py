@@ -71,8 +71,10 @@ def main(argv=None):
                     help="engine mode: precomputed structure (ell, the "
                          "default), 4 B/entry for isotropic real sectors "
                          "(compact), or recompute-on-the-fly (fused — the "
-                         "default with --shards, where a plan build would "
-                         "re-materialize the global arrays)")
+                         "default with --shards; plan builds also work "
+                         "shard-native, streaming peer shards from the "
+                         "file, and are worth their one-time cost for "
+                         "long solves)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--solver-checkpoint", default=None, metavar="CKPT_H5",

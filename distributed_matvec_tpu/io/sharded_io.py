@@ -34,7 +34,7 @@ from ..enumeration.host import shard_index
 
 __all__ = ["stream_block_to_shards", "save_hashed_vector",
            "save_hashed_vectors", "load_hashed_shard",
-           "hashed_vector_counts"]
+           "load_hashed_meta", "hashed_vector_counts"]
 
 _CHUNK = 1 << 20
 
@@ -103,7 +103,8 @@ def save_hashed_vector(path: str, xh, counts, name: str = "v") -> None:
     save_hashed_vectors(path, {name: xh}, counts)
 
 
-def save_hashed_vectors(path: str, vectors: dict, counts) -> None:
+def save_hashed_vectors(path: str, vectors: dict, counts,
+                        meta: Optional[dict] = None) -> None:
     """Write several named hashed arrays in ONE atomic file pass — the
     rewrite cost is paid once, not once per vector (a k-eigenvector save
     would otherwise re-copy all earlier vectors k times).
@@ -116,7 +117,12 @@ def save_hashed_vectors(path: str, vectors: dict, counts) -> None:
     other file content (other vector groups, co-located datasets/groups,
     root attrs) is carried over; an unreadable previous file is an error —
     silently replacing it would destroy co-located data the caller never
-    asked us to touch."""
+    asked us to touch.
+
+    ``meta`` (scalars/small arrays) is written under ``/ckpt_meta`` in the
+    SAME atomic pass, replacing any previous meta — so checkpoint metadata
+    and the vectors it describes can never be of mixed generations (see
+    solve/lanczos.py's multi-process checkpoint)."""
     import os
     import tempfile
 
@@ -141,6 +147,8 @@ def save_hashed_vectors(path: str, vectors: dict, counts) -> None:
                                 if other not in vectors:
                                     fin.copy(f"vector_shards/{other}", dst,
                                              name=other)
+                        elif k == "ckpt_meta" and meta is not None:
+                            pass             # replaced wholesale below
                         else:
                             fin.copy(k, fout, name=k)
                     for k, v in fin.attrs.items():
@@ -150,22 +158,59 @@ def save_hashed_vectors(path: str, vectors: dict, counts) -> None:
                 g = fout.require_group(f"vector_shards/{name}")
                 for d in range(D):
                     shard = None
-                    if isinstance(xh, jax.Array):
+                    if isinstance(xh, dict):
+                        # pre-fetched host pieces {d: rows} — lets callers
+                        # stage one device row at a time (solve/lanczos.py)
+                        shard = xh.get(d)
+                    elif isinstance(xh, jax.Array):
                         for piece in xh.addressable_shards:
                             if piece.index[0].start == d:
                                 shard = np.asarray(piece.data)[0]
                                 break
-                        if shard is None:
-                            continue        # another process's shard
                     else:
                         shard = np.asarray(xh)[d]
+                    if shard is None:
+                        continue            # another process's shard
                     g.create_dataset(str(d), data=shard[: counts[d]])
+            if meta is not None:
+                g = fout.require_group("ckpt_meta")
+                for k, v in meta.items():
+                    if isinstance(v, str):
+                        g.attrs[k] = v      # h5py rejects numpy str scalars
+                        continue
+                    a = np.asarray(v)
+                    if a.ndim == 0:
+                        g.attrs[k] = a[()]
+                    else:
+                        g.create_dataset(k, data=a)
             fout.attrs["counts"] = counts
             fout.attrs["n_shards"] = D
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
+
+
+def load_hashed_meta(path: str) -> Optional[dict]:
+    """The ``/ckpt_meta`` group of a hashed-vector file (attrs + datasets),
+    searched across ``path`` and any per-rank ``path.r*`` files; None when
+    absent."""
+    import glob
+    import h5py
+
+    for cand in [path] + sorted(glob.glob(f"{path}.r*")):
+        try:
+            with h5py.File(cand, "r") as f:
+                if "ckpt_meta" not in f:
+                    continue
+                g = f["ckpt_meta"]
+                out = {k: g.attrs[k] for k in g.attrs}
+                for k in g:
+                    out[k] = g[k][...]
+                return out
+        except OSError:
+            continue
+    return None
 
 
 def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:

@@ -183,27 +183,46 @@ def hash_basis_operator(h, operator, include_arrays: bool = True) -> None:
         h.update(np.ascontiguousarray(a).tobytes())
 
 
-def compact_magnitude(operator, sample_size: int = 4096) -> float:
+def compact_magnitude(operator, sample_size: int = 4096,
+                      sample_states=None) -> float:
     """The single off-diagonal magnitude W compact mode assumes, derived from
     a sample of rows *strided across the whole basis* (not just its head —
     an operator whose anisotropy only shows up deep in the basis should be
     refused here, cheaply, rather than after a minutes-long count/pack pass).
     Correctness never depends on this: every entry is re-validated against W
     during the pack.  Shared by the local and distributed engines so their
-    sample policies cannot drift."""
-    reps = operator.basis.representatives
-    n = reps.shape[0]
-    if n <= sample_size:
-        sample = reps
-    else:
-        sample = reps[np.linspace(0, n - 1, sample_size).astype(np.int64)]
-    _, amps = operator.apply_off_diag(np.ascontiguousarray(sample))
-    vals = np.unique(np.abs(amps[amps != 0]))
+    sample policies cannot drift.
+
+    ``sample_states`` supplies the sample directly for engines that never
+    materialize the global basis (shard-native: rows strided across the
+    hash-partitioned shards are an equally unbiased sample)."""
+    vals = compact_magnitudes(operator, sample_size, sample_states)
     if vals.size != 1:
         raise ValueError(
             f"compact mode needs a single off-diagonal magnitude, "
             f"found {vals[:5]}; use mode='ell'")
     return float(vals[0])
+
+
+def compact_magnitudes(operator, sample_size: int = 4096,
+                       sample_states=None) -> np.ndarray:
+    """The distinct off-diagonal magnitudes over the sampled rows (sorted;
+    possibly empty) — the non-raising core of :func:`compact_magnitude`,
+    for callers that must AGREE on the verdict across ranks before raising
+    (a rank-local raise would hang the peers in the next collective)."""
+    if sample_states is not None:
+        sample = np.asarray(sample_states, np.uint64)
+    else:
+        reps = operator.basis.representatives
+        n = reps.shape[0]
+        if n <= sample_size:
+            sample = reps
+        else:
+            sample = reps[np.linspace(0, n - 1, sample_size).astype(np.int64)]
+    if sample.size == 0:
+        return np.zeros(0)
+    _, amps = operator.apply_off_diag(np.ascontiguousarray(sample))
+    return np.unique(np.abs(amps[amps != 0]))
 
 
 def _padded_basis_arrays(reps: np.ndarray, norms: np.ndarray, n_pad: int):

@@ -92,6 +92,88 @@ def _operator_key(owner) -> str:
     return h.hexdigest()[:16]
 
 
+def _sharded_ckpt_engine(owner, shape) -> bool:
+    """True when the matvec's owner is a distributed engine whose hashed
+    [D, M(, 2)] vector layout matches ``shape`` — the case where a
+    multi-process checkpoint can be written per shard (each rank saves its
+    addressable shards; no rank ever fetches the global Krylov basis)."""
+    return (owner is not None
+            and hasattr(owner, "_assemble_sharded")
+            and hasattr(owner, "counts")
+            and len(shape) >= 2
+            and shape[0] == getattr(owner, "n_devices", -1)
+            and shape[1] == getattr(owner, "shard_size", -1))
+
+
+def _save_ckpt(path, fp, owner, V, meta, m, sharded) -> None:
+    """One checkpoint write.  Single-controller: the live basis rows in one
+    structure file (global array).  Multi-process engine-backed: each rank
+    writes its shards of every Krylov row plus the (replicated) recurrence
+    metadata in ONE atomic per-rank file — metadata and rows can never be
+    of mixed generations, and a crash mid-save leaves the previous
+    checkpoint intact."""
+    if not sharded:
+        from ..io.hdf5 import save_engine_structure
+        save_engine_structure(path, fp, "lanczos",
+                              dict(meta, V=np.asarray(V[: m + 1])))
+        return
+    from ..io.sharded_io import save_hashed_vectors
+    from ..parallel.mesh import shard_spec
+
+    spec = shard_spec(owner.mesh, V.ndim - 1)
+    row = jax.jit(lambda Vb, i: Vb[i], out_shardings=spec)
+    # one device row in flight at a time (a whole-basis dict of device
+    # rows would transiently double HBM right at the basis-size cap);
+    # host staging is this rank's shards only
+    rows = {}
+    for i in range(m + 1):
+        r = row(V, jnp.int32(i))
+        rows[f"krylov_{i}"] = {
+            piece.index[0].start: np.asarray(piece.data)[0]
+            for piece in r.addressable_shards}
+        del r
+    save_hashed_vectors(path, rows, owner.counts,
+                        meta=dict(meta, fingerprint=fp))
+
+
+def _restore_ckpt(path, fp, owner, shape, sharded):
+    """Inverse of :func:`_save_ckpt`; returns a dict with ``V_rows`` (list
+    of per-row arrays in the vector layout) plus the recurrence metadata,
+    or None when no matching checkpoint exists."""
+    if not sharded:
+        from ..io.hdf5 import load_engine_structure
+        got = load_engine_structure(path, fp)
+        if got is None:
+            return None
+        return dict(got, V_rows=[jnp.asarray(r) for r in got["V"]])
+    from ..io.sharded_io import load_hashed_meta, load_hashed_shard
+
+    meta = load_hashed_meta(path)
+    if meta is None or str(meta.get("fingerprint", "")) != fp:
+        return None
+    m = int(meta["m"])
+    D, M = owner.n_devices, owner.shard_size
+    tail = shape[2:]
+    rows_out = []
+    try:
+        for i in range(m + 1):
+            pieces = [None] * D
+            for d in range(D):
+                if not owner._shard_addressable(d):
+                    continue
+                r = load_hashed_shard(path, d, name=f"krylov_{i}")
+                full = np.zeros((M,) + tuple(tail))
+                full[: r.shape[0]] = r
+                pieces[d] = full
+            rows_out.append(owner._assemble_sharded(pieces))
+    except KeyError:
+        from ..utils.logging import log_debug
+        log_debug("lanczos sharded checkpoint incomplete (row data missing "
+                  "for this rank's shards); starting fresh")
+        return None
+    return dict(meta, V_rows=rows_out)
+
+
 def _rand_like(shape, dtype, seed):
     rng = np.random.default_rng(seed)
     v = rng.standard_normal(shape)
@@ -264,8 +346,10 @@ def lanczos(
     edited Hamiltonian of the same size starts fresh instead of restoring
     a foreign Krylov state.  Bare callables are keyed by shape only —
     there, a fresh path per problem remains the caller's responsibility.
-    Single-controller only (the basis fetch is a global read); ignored
-    with a debug log in multi-process runs.
+    In a multi-process run an ENGINE-backed solve checkpoints per shard
+    (each rank atomically writes its addressable shards of every Krylov
+    row + the replicated recurrence state to ``path.r<rank>``); bare
+    callables have no per-shard layout and are ignored with a debug log.
     """
     # Engines expose (apply_fn, operands) so the block runner can pass the
     # matrix tables as jit arguments; plain callables fall back to empty
@@ -347,21 +431,46 @@ def lanczos(
     ckpt_fp = f"{tuple(shape)}|{np.dtype(dtype).str}|{_operator_key(owner)}" \
         "|lanczos-v2"
     resumed_from = 0
-    if checkpoint_path and jax.process_count() > 1:
+    multi = jax.process_count() > 1
+    # Multi-process checkpointing needs a per-shard vector format (no rank
+    # can fetch the global Krylov basis): available for engine-backed
+    # matvecs over hashed [D, M(, 2)] vectors; bare callables stay
+    # single-controller-only.
+    sharded_ckpt = multi and _sharded_ckpt_engine(owner, shape)
+    if checkpoint_path and multi and not sharded_ckpt:
         from ..utils.logging import log_debug
-        log_debug("lanczos checkpointing disabled in multi-process runs")
+        log_debug("lanczos checkpointing disabled: multi-process run with "
+                  "a non-engine matvec (no per-shard vector layout)")
         checkpoint_path = None
     if checkpoint_path:
-        from ..io.hdf5 import load_engine_structure
-        got = load_engine_structure(checkpoint_path, ckpt_fp)
+        got = _restore_ckpt(checkpoint_path, ckpt_fp, owner, shape,
+                            sharded=sharded_ckpt)
+        if sharded_ckpt:
+            # Per-rank checkpoint files are written without a barrier, so
+            # ranks can observe different generations (or one none at all).
+            # Resuming from mixed states would desynchronize the SPMD
+            # collective programs — agree on (m, total_iters) and start
+            # fresh everywhere unless every rank restored the same state.
+            from jax.experimental import multihost_utils as _mhu
+            tok = np.array([got["m"], got["total_iters"]] if got is not None
+                           else [-1, -1], np.int64)
+            all_tok = _mhu.process_allgather(tok)
+            if not (all_tok >= 0).all() or \
+                    not (all_tok == all_tok[0]).all():
+                if got is not None:
+                    from ..utils.logging import log_debug
+                    log_debug("lanczos checkpoint generations disagree "
+                              "across ranks; starting fresh")
+                got = None
         if got is not None:
-            rows = int(got["V"].shape[0])
+            rows = int(got["m"]) + 1
             if rows > _buffer_rows(mcap) or int(got["m"]) > mcap:
                 from ..utils.logging import log_debug
                 log_debug("lanczos checkpoint basis exceeds max_basis_size; "
                           "starting fresh")
             else:
-                V = V.at[:rows].set(jnp.asarray(got["V"]))
+                for i, row in enumerate(got["V_rows"]):
+                    V = V.at[i].set(row)
                 na = min(int(got["m"]), mcap)
                 alph_d = alph_d.at[:na].set(
                     jnp.asarray(got["alph"][:na]))
@@ -444,13 +553,12 @@ def lanczos(
 
         blocks_done += 1
         if checkpoint_path and blocks_done % max(checkpoint_every, 1) == 0:
-            from ..io.hdf5 import save_engine_structure
-            save_engine_structure(checkpoint_path, ckpt_fp, "lanczos", {
-                "V": np.asarray(V[: m + 1]),
+            _save_ckpt(checkpoint_path, ckpt_fp, owner, V, {
                 "alph": np.asarray(alph_d), "bet": np.asarray(bet_d),
                 "lock_theta": np.asarray(lock_theta),
                 "lock_sigma": np.asarray(lock_sigma),
-                "m": int(m), "total_iters": int(total_iters)})
+                "m": int(m), "total_iters": int(total_iters)},
+                m, sharded_ckpt)
 
     kk = min(k, m)
     evecs = None

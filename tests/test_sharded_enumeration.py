@@ -245,7 +245,7 @@ def test_cli_shards_saves_sharded_eigenvectors(tmp_path):
     import h5py
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="true",
-               PYTHONPATH="/root/repo",
+               PYTHONPATH=os.path.join(os.path.dirname(__file__), os.pardir),
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
     app = os.path.join(os.path.dirname(__file__), os.pardir, "apps",
                        "diagonalize.py")
