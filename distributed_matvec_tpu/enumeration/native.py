@@ -32,7 +32,7 @@ from . import host as _host
 from ..utils.logging import log_debug
 
 __all__ = ["native_available", "enumerate_representatives_native",
-           "lookup_owners", "full_state_range", "rank_state_range"]
+           "lookup_owners", "full_state_range", "rank_state_ranges"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_native.cpp")
@@ -160,19 +160,28 @@ def full_state_range(n_sites: int, hamming_weight: Optional[int]):
     return lo, hi
 
 
-def rank_state_range(n_sites: int, hamming_weight: Optional[int],
-                     rank: int, n_ranks: int):
-    """Contiguous equal-index-work state range for one rank of ``n_ranks``
+def rank_state_ranges(n_sites: int, hamming_weight: Optional[int],
+                      rank: int, n_ranks: int, oversub: int = 64):
+    """CYCLIC equal-index-work chunk assignment for one rank of ``n_ranks``
     enumerating processes — the cross-process analog of the reference's
-    per-locale chunk assignment (StatesEnumeration.chpl:321-334), split in
-    fixed-hamming *index* space (determineEnumerationRanges, :94-113) so
-    every rank sees the same candidate count.  Returns None when the sector
-    has fewer candidates than ranks and this rank got nothing."""
+    per-locale dynamic chunk scheduling (StatesEnumeration.chpl:321-334),
+    split in fixed-hamming *index* space (determineEnumerationRanges,
+    :94-113).
+
+    Equal candidate counts are NOT equal representative counts: canonical
+    (orbit-minimal) representatives pile up at numerically small states,
+    so one contiguous slice per rank would hand essentially all survivors
+    to rank 0 (measured: 4 707 968 of 4 707 969 on chain_32_symm).
+    ``oversub``·n_ranks chunks dealt round-robin average the density out
+    while keeping each rank's chunk sequence ascending — every rank's
+    part file stays internally sorted, and :func:`..sharded.load_shard`
+    merge-sorts the per-rank slices.  Returns a (possibly empty) list of
+    inclusive (lo, hi) ranges."""
     lo, hi = full_state_range(n_sites, hamming_weight)
-    starts, ends = _ranges(lo, hi, hamming_weight, n_ranks)
-    if rank >= starts.size:
-        return None
-    return int(starts[rank]), int(ends[rank])
+    starts, ends = _ranges(lo, hi, hamming_weight, n_ranks * oversub)
+    return [(int(s), int(e))
+            for i, (s, e) in enumerate(zip(starts, ends))
+            if i % n_ranks == rank]
 
 
 def _stream_native(
@@ -184,18 +193,18 @@ def _stream_native(
     n_threads: Optional[int] = None,
     norm_tol: float = 1e-12,
     batch_tasks: int = 256,
-    state_range=None,
+    state_ranges=None,
 ):
     """Generator over (states, norms) survivor slabs in ascending state
     order — the chunk ranges are disjoint and ascending, so concatenating
     the slabs (or routing them anywhere) preserves global sortedness.
     Memory is bounded by one task batch's buffers.
 
-    ``state_range=(lo, hi)`` restricts the scan to a sub-range (inclusive)
-    — the multi-process enumeration path hands each rank its own slice."""
+    ``state_ranges=[(lo, hi), ...]`` restricts the scan to the given
+    ascending disjoint sub-ranges (inclusive) — the multi-process
+    enumeration path hands each rank its cyclic chunk set
+    (:func:`rank_state_ranges`)."""
     lo, hi = full_state_range(n_sites, hamming_weight)
-    if state_range is not None:
-        lo, hi = int(state_range[0]), int(state_range[1])
 
     ls, rs, ms, xor, chr_ = _group_tables_cheap_first(group)
     G, S = ms.shape
@@ -215,7 +224,16 @@ def _stream_native(
     n_threads = n_threads or os.cpu_count() or 1
     if n_chunks is None:
         n_chunks = max(4 * n_threads, 64)
-    starts, ends = _ranges(lo, hi, hamming_weight, n_chunks)
+    if state_ranges is not None:
+        if not state_ranges:
+            return
+        per = max(1, n_chunks // len(state_ranges))
+        parts = [_ranges(rlo, rhi, hamming_weight, per)
+                 for rlo, rhi in state_ranges]
+        starts = np.concatenate([p[0] for p in parts])
+        ends = np.concatenate([p[1] for p in parts])
+    else:
+        starts, ends = _ranges(lo, hi, hamming_weight, n_chunks)
     ntasks = starts.size
     if ntasks == 0:
         return

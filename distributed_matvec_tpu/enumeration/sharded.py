@@ -80,26 +80,27 @@ def enumerate_to_shards(
 
     **Multi-process enumeration** (the analog of the reference's
     per-locale concurrent enumeration, StatesEnumeration.chpl:321-334):
-    with ``n_ranks > 1`` this call enumerates only rank ``rank``'s
-    contiguous equal-index-work slice of the candidate space and writes it
-    to ``path.part<rank>``; every rank runs the same call concurrently
-    (separate processes), then ONE caller runs
-    :func:`finalize_shard_parts` to census-validate the union and write
-    the manifest at ``path``.  Because rank slices ascend and each rank's
-    shard stream is sorted, per-shard concatenation in rank order is
-    globally sorted — :func:`load_shard` does exactly that.
+    with ``n_ranks > 1`` this call enumerates only rank ``rank``'s CYCLIC
+    set of 64·R equal-index-work chunks (round-robin dealing balances the
+    skew of canonical representatives toward small states — see
+    ``native.rank_state_ranges``) and writes it to ``path.part<rank>``;
+    every rank runs the same call concurrently (separate processes), then
+    ONE caller runs :func:`finalize_shard_parts` to census-validate the
+    union and write the manifest at ``path``.  Each rank's shard stream is
+    internally sorted (its chunks ascend), but ranks interleave in state
+    space — :func:`load_shard` merge-sorts the per-rank slices.
     """
     import h5py
 
     if not (0 <= rank < n_ranks):
         raise ValueError(f"rank {rank} outside 0..{n_ranks - 1}")
     fp = _fingerprint(n_sites, hamming_weight, group, n_shards, norm_tol)
-    state_range = None
+    state_ranges = None
     if n_ranks > 1:
         path = f"{path}.part{rank}"
-        fp = f"{fp}|part{rank}/{n_ranks}"
+        fp = f"{fp}|part{rank}/{n_ranks}c64"   # c64 = cyclic-chunk layout
         census_check = False     # only the union can be censused
-        state_range = _native.rank_state_range(
+        state_ranges = _native.rank_state_ranges(
             n_sites, hamming_weight, rank, n_ranks)
     if os.path.exists(path):
         man = shard_manifest(path)
@@ -154,11 +155,10 @@ def enumerate_to_shards(
             pending[d] = 0
 
         done = 0
-        slabs = () if (n_ranks > 1 and state_range is None) \
-            else _native._stream_native(
-                lib, n_sites, hamming_weight, group,
-                n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol,
-                batch_tasks=32, state_range=state_range)
+        slabs = _native._stream_native(
+            lib, n_sites, hamming_weight, group,
+            n_chunks=n_chunks, n_threads=n_threads, norm_tol=norm_tol,
+            batch_tasks=32, state_ranges=state_ranges)
         for slab_s, slab_n in slabs:
             owner = shard_index(slab_s, D)
             # single-pass scatter: stable sort by owner keeps each shard's
@@ -225,10 +225,11 @@ def finalize_shard_parts(
     ``path``.  Run by ONE process after every rank's part exists.
 
     The manifest holds only counts/attrs and the part list — shard data
-    stays in the part files; :func:`load_shard` concatenates a shard's
-    slices in rank order (globally sorted by construction).  The union
-    total is validated against the sector-dimension census — the same
-    independent combinatorial cross-check the single-process path runs.
+    stays in the part files; :func:`load_shard` merge-sorts a shard's
+    per-rank slices (each internally sorted, interleaved in state space).
+    The union total is validated against the sector-dimension census — the
+    same independent combinatorial cross-check the single-process path
+    runs.
     """
     import h5py
 
@@ -240,7 +241,7 @@ def finalize_shard_parts(
     counts = np.zeros(n_shards, np.int64)
     for r in range(n_ranks):
         pman = shard_manifest(f"{path}.part{r}")
-        want_fp = f"{fp}|part{r}/{n_ranks}"
+        want_fp = f"{fp}|part{r}/{n_ranks}c64"
         if pman is None or pman.get("fingerprint") != want_fp:
             raise RuntimeError(
                 f"part file {path}.part{r} is missing or does not match "
@@ -297,8 +298,10 @@ def shard_manifest(path: str) -> Optional[dict]:
 def load_shard(path: str, d: int):
     """(representatives, norms) of one shard — sorted ascending; only this
     shard's data is read into memory.  For a multi-process manifest the
-    shard is the rank-order concatenation of the part files' slices
-    (sorted because rank state-ranges ascend)."""
+    shard is the MERGE of the part files' slices: each rank's slice is
+    internally sorted (its cyclic chunks ascend), but ranks interleave in
+    state space, so a k-way merge (stable argsort over the concatenation)
+    restores the global per-shard order."""
     import h5py
 
     with h5py.File(path, "r") as f:
@@ -313,4 +316,9 @@ def load_shard(path: str, d: int):
             g = f["shards"][str(d)]
             reps.append(g["representatives"][...])
             norms.append(g["norms"][...])
-    return np.concatenate(reps), np.concatenate(norms)
+    reps = np.concatenate(reps)
+    norms = np.concatenate(norms)
+    if reps.size and not (reps[:-1] <= reps[1:]).all():
+        order = np.argsort(reps, kind="stable")
+        reps, norms = reps[order], norms[order]
+    return reps, norms

@@ -1410,6 +1410,11 @@ class DistributedEngine:
                 )
             y, overflow, invalid = self._matvec(xh)
             key = self._last_program_key
+            if isinstance(overflow, jax.core.Tracer):
+                # called under an outer trace (e.g. lobpcg_standard's
+                # while_loop): the counters are abstract — defer validation
+                # to the next eager call (callers' eager probes run first)
+                return y
             if check or (check is None and key not in self._checked):
                 if int(overflow):
                     cap = (self._last_capacity if self._last_capacity

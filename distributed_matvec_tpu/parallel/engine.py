@@ -1019,6 +1019,12 @@ class LocalEngine:
                     f"f64 vectors (or complex input), got shape {np.shape(x)}"
                 )
             y, bad = self._matvec(jnp.asarray(x))
+            if isinstance(bad, jax.core.Tracer):
+                # under an outer trace the counter is abstract — defer
+                # validation to the next eager call.  y is a tracer too,
+                # so it goes back unconverted (pair form) even for complex
+                # input; traced callers consume pair arrays natively.
+                return y
             if check or (check is None and not self._checked):
                 if int(bad) != 0:
                     raise RuntimeError(

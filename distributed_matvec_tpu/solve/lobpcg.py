@@ -16,6 +16,7 @@ operates on the sharded flats.  Pad slots start at zero (``to_hashed`` zero
 fills) and stay zero — H maps them to 0 and all LOBPCG updates are linear
 combinations — so the flat space behaves exactly like the n-dimensional
 physical space.
+
 """
 
 from __future__ import annotations
@@ -77,17 +78,25 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         pair = bool(getattr(owner, "pair", False))
     dist = owner is not None and hasattr(owner, "from_hashed")
     if dist and jax.process_count() > 1:
+        # jax's lobpcg_standard jits its matvec CALLABLE with the closure's
+        # captured arrays baked in as compile-time constants; a distributed
+        # engine's operands span processes, and jit refuses process-spanning
+        # constants ("closing over jax.Array that spans non-addressable
+        # devices").  Until the iteration is re-hosted on an
+        # operands-as-arguments step (the lanczos block-runner pattern),
+        # distributed blocked solves stay single-controller; local engines
+        # and bare callables (process-local operands) are unaffected.
         raise ValueError(
-            "LOBPCG is single-controller (host-side QR and J-copy dedup "
-            "need the whole flat space addressable); use solve.lanczos "
-            "for multi-process runs"
+            "LOBPCG is single-controller (jax lobpcg_standard cannot "
+            "carry process-spanning engine operands through its jitted "
+            "closure); use solve.lanczos for multi-process runs"
         )
 
     def run_flipped(mv, dim_, U0):
         """sigma estimate, spectrum-flipped lobpcg_standard, ascending
         (evals, columns, iters) output: the scaffold every branch shares."""
         sigma = _norm_estimate(mv, dim_)
-        U0q, _ = np.linalg.qr(U0)
+        U0q, _ = np.linalg.qr(np.asarray(U0))
         theta, U, iters = lobpcg_standard(
             lambda X: sigma * X - mv(X), jnp.asarray(U0q),
             m=max_iters, tol=tol)

@@ -25,11 +25,37 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _rank_worker(args):
+    """One rank's slice of a multi-process enumeration (spawned process;
+    the group is rebuilt in-process from the YAML config)."""
+    config, out, n_shards, rank, n_ranks, chunks, threads = args
+    from distributed_matvec_tpu.enumeration.sharded import enumerate_to_shards
+    from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+
+    cfg = load_config_from_yaml(
+        os.path.join("/root/reference/data", config + ".yaml"))
+    b = cfg.basis
+    t0 = time.time()
+    man = enumerate_to_shards(b.number_spins, b.hamming_weight, b.group,
+                              n_shards, out, rank=rank, n_ranks=n_ranks,
+                              n_chunks=chunks, n_threads=threads)
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    return rank, man["total"], time.time() - t0, rss, man["restored"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="heisenberg_chain_40_symm")
     ap.add_argument("--out", default=None)
     ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--ranks", type=int, default=1,
+                    help="enumerating processes: each rank streams a "
+                         "disjoint index-space slice into its own part "
+                         "file concurrently (the per-locale parallel "
+                         "enumeration of StatesEnumeration.chpl:321-334), "
+                         "then one finalize census-validates the union")
+    ap.add_argument("--threads-per-rank", type=int, default=None,
+                    help="native threads per rank (default: cpus/ranks)")
     ap.add_argument("--chunks", type=int, default=None,
                     help="enumeration range chunks (default: sized so one "
                          "256-task batch stays under ~1 GB of buffers)")
@@ -62,12 +88,39 @@ def main():
           flush=True)
 
     t0 = time.time()
-    man = enumerate_to_shards(n, hw, group, args.shards, out, n_chunks=chunks)
-    dt = time.time() - t0
-    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
-    print(f"total {man['total']} representatives "
-          f"({'restored' if man['restored'] else f'{dt:.1f} s'}), "
-          f"counts {man['counts']}, peak RSS {rss} MB", flush=True)
+    if args.ranks > 1:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+
+        from distributed_matvec_tpu.enumeration.sharded import (
+            finalize_shard_parts)
+
+        threads = args.threads_per_rank or max(
+            (os.cpu_count() or 1) // args.ranks, 1)
+        ctx = mp.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=args.ranks,
+                                 mp_context=ctx) as ex:
+            results = list(ex.map(_rank_worker, [
+                (args.config, out, args.shards, r, args.ranks,
+                 chunks, threads) for r in range(args.ranks)]))
+        for rank, tot, dt_r, rss_r, restored in results:
+            print(f"rank {rank}: {tot} representatives "
+                  f"({'restored' if restored else f'{dt_r:.1f} s'}), "
+                  f"peak RSS {rss_r} MB", flush=True)
+        man = finalize_shard_parts(n, hw, group, args.shards, out,
+                                   args.ranks)
+        dt = time.time() - t0
+        print(f"total {man['total']} representatives in {dt:.1f} s wall "
+              f"({args.ranks} ranks x {threads} threads), "
+              f"counts {man['counts']}", flush=True)
+    else:
+        man = enumerate_to_shards(n, hw, group, args.shards, out,
+                                  n_chunks=chunks)
+        dt = time.time() - t0
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+        print(f"total {man['total']} representatives "
+              f"({'restored' if man['restored'] else f'{dt:.1f} s'}), "
+              f"counts {man['counts']}, peak RSS {rss} MB", flush=True)
     assert man["total"] == census, (man["total"], census)
     print("CENSUS_OK", flush=True)
 
