@@ -164,9 +164,10 @@ def main(argv=None):
     with timer.scope("solve"), maybe_profile():
         t0 = time.perf_counter()
         if args.block:
-            if jax.process_count() > 1:
-                print("--block (LOBPCG) is single-controller; use Lanczos "
-                      "(default) for multi-process runs", file=sys.stderr)
+            if jax.process_count() > 1 and not hasattr(eng, "from_hashed"):
+                print("--block (LOBPCG) in a multi-process run needs a "
+                      "distributed engine (--devices or --shards)",
+                      file=sys.stderr)
                 return 2
             if args.solver_checkpoint:
                 print("warning: --solver-checkpoint applies to Lanczos "

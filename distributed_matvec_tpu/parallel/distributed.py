@@ -1374,12 +1374,17 @@ class DistributedEngine:
             xh = multihost_utils.process_allgather(xh, tiled=True)
         return self._require_layout().from_hashed(np.asarray(xh))
 
-    def random_hashed(self, seed: int = 0):
-        """A normalized random vector directly in hashed layout (pads
-        zero).  Generated per shard (deterministic in (seed, shard)), so a
-        shard-native engine never touches a global array; the norm is a
-        device reduction over the sharded vector."""
+    def random_hashed(self, seed: int = 0, cols: Optional[int] = None):
+        """A normalized random vector — or, with ``cols``, a [D, M, cols]
+        block of per-column-normalized vectors — directly in hashed layout
+        (pads zero).  Generated per shard (deterministic in
+        (seed, shard)), so a shard-native engine never touches a global
+        array; norms are device reductions over the sharded axes.  This is
+        the ONE home of the per-shard seeding/pad-zero invariants — block
+        consumers (LOBPCG start blocks) use ``cols`` rather than
+        re-deriving them."""
         D, M = self.n_devices, self.shard_size
+        tail = ((cols,) if cols else ()) + ((2,) if self.pair else ())
         rows = [None] * D
         for d in range(D):
             if not self._shard_addressable(d):
@@ -1387,11 +1392,19 @@ class DistributedEngine:
             rng = np.random.default_rng(
                 np.random.SeedSequence((seed, d)))
             c = int(self.counts[d])
-            x = np.zeros((M, 2) if self.pair else M)
-            x[:c] = rng.standard_normal((c, 2) if self.pair else c)
+            x = np.zeros((M,) + tail)
+            x[:c] = rng.standard_normal((c,) + tail)
             rows[d] = x
         xh = self._assemble_sharded(rows)
-        nrm = jax.jit(lambda a: jnp.sqrt(jnp.sum(a * a)))(xh)
+        if cols is None:
+            nrm = jax.jit(lambda a: jnp.sqrt(jnp.sum(a * a)))(xh)
+            return jax.jit(jnp.divide)(xh, nrm)
+        ax = (0, 1, 3) if self.pair else (0, 1)
+
+        def col_norm(a):
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=True))
+
+        nrm = jax.jit(col_norm)(xh)
         return jax.jit(jnp.divide)(xh, nrm)
 
     def matvec(self, xh, check: Optional[bool] = None) -> jax.Array:

@@ -17,6 +17,13 @@ fills) and stay zero — H maps them to 0 and all LOBPCG updates are linear
 combinations — so the flat space behaves exactly like the n-dimensional
 physical space.
 
+Multi-process runs work for distributed engines: jax's jitted
+``lobpcg_standard`` cannot bake process-spanning engine operands into its
+closure, so the UNJITTED body runs under this module's own jit with the
+operands as explicit arguments (closures over tracers are ordinary jax);
+the start block is generated per shard, orthonormalization of the tall
+block uses Gram + Cholesky, and only the final eigenvector output
+allgathers.
 """
 
 from __future__ import annotations
@@ -77,20 +84,41 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     if pair is None:
         pair = bool(getattr(owner, "pair", False))
     dist = owner is not None and hasattr(owner, "from_hashed")
-    if dist and jax.process_count() > 1:
+    multi = dist and jax.process_count() > 1
+    raw_lobpcg = None
+    if multi:
         # jax's lobpcg_standard jits its matvec CALLABLE with the closure's
         # captured arrays baked in as compile-time constants; a distributed
         # engine's operands span processes, and jit refuses process-spanning
         # constants ("closing over jax.Array that spans non-addressable
-        # devices").  Until the iteration is re-hosted on an
-        # operands-as-arguments step (the lanczos block-runner pattern),
-        # distributed blocked solves stay single-controller; local engines
-        # and bare callables (process-local operands) are unaffected.
-        raise ValueError(
-            "LOBPCG is single-controller (jax lobpcg_standard cannot "
-            "carry process-spanning engine operands through its jitted "
-            "closure); use solve.lanczos for multi-process runs"
-        )
+        # devices").  The multi-process path therefore runs the UNJITTED
+        # LOBPCG body under OUR jit with the engine operands as explicit
+        # arguments — inside that jit the operands are tracers, and a
+        # closure over tracers is ordinary jax.  Every step is
+        # SPMD-consistent device math (matmuls/reductions over the sharded
+        # flat axis; eigh/QR only on small replicated matrices).
+        from jax.experimental.sparse.linalg import (
+            _lobpcg_standard_callable as _cal)
+        raw_lobpcg = getattr(_cal, "__wrapped__", None)
+        if raw_lobpcg is None or not hasattr(owner, "bound_matvec"):
+            raise ValueError(
+                "multi-process LOBPCG needs jax's unjitted lobpcg body "
+                "and an engine exposing bound_matvec; use solve.lanczos"
+            )
+        if getattr(matvec, "__func__", None) \
+                is not getattr(type(owner), "matvec", None):
+            # the multi path substitutes the engine's bound_matvec; a
+            # wrapped/shifted bound method would silently solve a
+            # DIFFERENT operator (same contract as solve/lanczos.py)
+            raise ValueError(
+                "multi-process LOBPCG only accepts the engine's own "
+                "matvec method; wrap the operator, not the matvec, or "
+                "use solve.lanczos"
+            )
+        if X0 is not None:
+            raise ValueError(
+                "multi-process LOBPCG cannot consume a global warm-start "
+                "X0; run without X0 or use solve.lanczos")
 
     def run_flipped(mv, dim_, U0):
         """sigma estimate, spectrum-flipped lobpcg_standard, ascending
@@ -131,8 +159,14 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             return to_flat(raw_mv(from_flat(U)))
 
         def block_x0(m):
-            """Random block-order start (pads land zero via to_hashed);
-            warm-start columns are eigenvector guesses, capped at k."""
+            """Random start block (pads zero), warm-start columns capped
+            at k.  Multi-process: generated directly in hashed layout per
+            shard (deterministic in (seed, shard)) — no global host array;
+            X0 was rejected up front."""
+            if multi:
+                # per-shard generation lives in the engine (one home for
+                # the seeding/pad-zero invariants)
+                return to_flat(owner.random_hashed(seed=seed, cols=m))
             rng = np.random.default_rng(seed)
             Xb = rng.standard_normal((n, m))
             if pair:
@@ -147,15 +181,56 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             return np.asarray(to_flat(owner.to_hashed(Xb)))
 
         def cols_to_block(U):
-            """Flat columns → block order; complex for pair engines."""
-            V = owner.from_hashed(from_flat(jnp.asarray(np.asarray(U))))
+            """Flat columns → block order; complex for pair engines.
+            (from_hashed allgathers in multi-process runs — the global
+            eigenvector output is inherently global.)"""
+            V = owner.from_hashed(from_flat(jnp.asarray(U)))
             if pair:
                 return V[..., 0] + 1j * V[..., 1]       # [n, m] complex
             return V                                    # [n, m]
 
+        def run_flipped_multi(U0):
+            """Multi-process scaffold: eager hashed power iteration for
+            sigma (also runs the engine's counter validation), Gram +
+            Cholesky orthonormalization of the sharded start block (the
+            [m, m] Gram is a psum-reduced matmul, replicated on every
+            rank), then the unjitted LOBPCG body under one jit with the
+            engine operands as arguments."""
+            vh = owner.random_hashed(seed=seed + 1)
+            lam = 0.0
+            for _ in range(20):
+                w = raw_mv(vh)
+                lam = float(jnp.sqrt(jnp.real(jnp.vdot(w, w))))
+                vh = w / lam
+            sigma = 1.05 * lam
+
+            G = np.asarray(jax.jit(lambda A: A.T @ A)(U0))
+            L = np.linalg.cholesky(
+                G + 1e-12 * np.trace(G) * np.eye(G.shape[1]))
+            Li = jnp.asarray(np.linalg.inv(L))
+            apply_fn, operands = owner.bound_matvec()
+
+            def mv_ops(Xb, ops):
+                Y = apply_fn(from_flat(Xb), ops)
+                return to_flat(Y[0] if isinstance(Y, tuple) else Y)
+
+            @jax.jit
+            def _run(X, Li_, ops):
+                Xq = X @ Li_.T
+                return raw_lobpcg(
+                    lambda Xb: sigma * Xb - mv_ops(Xb, ops),
+                    Xq, max_iters, tol, False)
+
+            theta, U, iters = _run(U0, Li, operands)
+            evals = sigma - np.asarray(theta)
+            order = np.argsort(evals)
+            return sigma, evals[order], U[:, jnp.asarray(order)], int(iters)
+
     if not pair:
         if dist:
-            _, evals, U, iters = run_flipped(mv_flat, dim, block_x0(k))
+            _, evals, U, iters = (run_flipped_multi(block_x0(k)) if multi
+                                  else run_flipped(mv_flat, dim,
+                                                   block_x0(k)))
             return evals, cols_to_block(U), iters
         if X0 is None:
             X0 = np.random.default_rng(seed).standard_normal((n, k))
@@ -177,7 +252,9 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         )
 
     if dist:
-        sigma, evals, U, iters = run_flipped(mv_flat, dim, block_x0(kk))
+        sigma, evals, U, iters = (run_flipped_multi(block_x0(kk)) if multi
+                                  else run_flipped(mv_flat, dim,
+                                                   block_x0(kk)))
     else:
         def mv_flat_local(U):
             """[2n, m] f64 → engine pair batch [n, m, 2] → back."""

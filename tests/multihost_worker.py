@@ -64,6 +64,17 @@ e0 = float(res.eigenvalues[0])
 print(f"[p{pid}] lanczos E0/4 = {e0 / 4:.10f}", flush=True)
 assert abs(e0 / 4 - E0_OVER_4) < 1e-7
 
+# multi-process LOBPCG: the unjitted lobpcg body runs under our jit with
+# the engine operands as arguments; start block generated per shard
+from distributed_matvec_tpu.solve import lobpcg
+
+evals_b, V_b, iters_b = lobpcg(eng.matvec, basis.number_states, k=2,
+                               tol=1e-8)
+print(f"[p{pid}] lobpcg E0/4 = {evals_b[0] / 4:.10f} ({iters_b} iters)",
+      flush=True)
+assert abs(evals_b[0] / 4 - E0_OVER_4) < 1e-6
+assert V_b.shape == (basis.number_states, 2)
+
 # shard-native construction in a multi-controller run: every process
 # loads only its addressable shards from the (pre-written) shard file,
 # the basis is never built globally, and the solve stays hashed.  The

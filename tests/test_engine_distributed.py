@@ -460,6 +460,7 @@ def test_multihost_two_process(tmp_path):
         if shards:      # the shard-native leg must actually have run
             assert f"[p{pid}] from_shards compact: matvec" in out, out[-2000:]
             assert f"[p{pid}] from_shards resumed E0/4" in out, out[-2000:]
+            assert f"[p{pid}] lobpcg E0/4" in out, out[-2000:]
 
 
 @needs_8
