@@ -20,6 +20,10 @@ __all__ = [
     "square_diagonal_edges",
     "kagome_12_edges",
     "kagome_16_edges",
+    "kagome_torus_edges",
+    "kagome_36_edges",
+    "pyrochlore_edges",
+    "heisenberg_pyrochlore",
     "heisenberg_chain",
     "heisenberg_square",
     "heisenberg_kagome",
@@ -102,6 +106,72 @@ def kagome_16_edges() -> List[Tuple[int, int]]:
     ]
 
 
+def kagome_torus_edges(lx: int, ly: int) -> List[Tuple[int, int]]:
+    """Periodic kagome lattice of ``lx × ly`` three-site unit cells (the
+    geometry behind the reference's commented ``benchmark-kagome-36``
+    workload, Makefile:85,108 — 36 sites at lx=4, ly=3).
+
+    Cell (x, y) carries sublattice sites a/b/c; nearest-neighbour bonds are
+    the up-triangle (a-b, a-c, b-c) plus the down-triangle closures
+    b(x,y)-a(x+1,y), c(x,y)-a(x,y+1), b(x,y)-c(x+1,y-1) — giving every
+    site coordination 4.  Wrap-doubled bonds on width-≤2 tori keep their
+    multiplicity (both couplings are physical, as in :func:`square_edges`).
+    """
+    def site(x, y, s):
+        return 3 * ((y % ly) * lx + (x % lx)) + s
+
+    edges: List[Tuple[int, int]] = []
+    for y in range(ly):
+        for x in range(lx):
+            a, b, c = site(x, y, 0), site(x, y, 1), site(x, y, 2)
+            edges += [(a, b), (a, c), (b, c)]
+            edges += [(b, site(x + 1, y, 0)),
+                      (c, site(x, y + 1, 0)),
+                      (b, site(x + 1, y - 1, 2))]
+    return edges
+
+
+def kagome_36_edges() -> List[Tuple[int, int]]:
+    """36-site periodic kagome cluster (4×3 unit cells)."""
+    return kagome_torus_edges(4, 3)
+
+
+def pyrochlore_edges(lx: int, ly: int, lz: int) -> List[Tuple[int, int]]:
+    """Periodic pyrochlore lattice of ``lx × ly × lz`` four-site cells (the
+    reference's commented ``benchmark-pyrochlore-2x2x2`` workload,
+    Makefile:84,107 — 32 sites at 2×2×2).
+
+    Corner-sharing tetrahedra on an FCC cell grid: the UP tetrahedron of
+    cell r is its four sublattice sites (6 bonds); the DOWN tetrahedron's
+    corners are site s of cell r + a_s (a_0 = 0, a_1/2/3 = the three cell
+    steps), giving 6 more — coordination 6 everywhere.
+    """
+    def site(x, y, z, s):
+        return 4 * (((z % lz) * ly + (y % ly)) * lx + (x % lx)) + s
+
+    a = ((0, 0, 0), (1, 0, 0), (0, 1, 0), (0, 0, 1))
+    edges: List[Tuple[int, int]] = []
+    for z in range(lz):
+        for y in range(ly):
+            for x in range(lx):
+                for i in range(4):
+                    for j in range(i + 1, 4):
+                        edges.append((site(x, y, z, i), site(x, y, z, j)))
+                        edges.append((
+                            site(x + a[i][0], y + a[i][1], z + a[i][2], i),
+                            site(x + a[j][0], y + a[j][1], z + a[j][2], j)))
+    return edges
+
+
+def heisenberg_pyrochlore(lx: int = 2, ly: int = 2, lz: int = 2) -> Operator:
+    """Heisenberg model on the periodic pyrochlore lattice (32 sites at the
+    reference's 2×2×2 benchmark size)."""
+    n = 4 * lx * ly * lz
+    basis = SpinBasis(n, n // 2)
+    return heisenberg_from_edges(basis, pyrochlore_edges(lx, ly, lz),
+                                 spin_half_ops=True)
+
+
 def _translation(n: int) -> List[int]:
     return [(i + 1) % n for i in range(n)]
 
@@ -140,6 +210,8 @@ def heisenberg_kagome(n: int) -> Operator:
         edges = kagome_12_edges()
     elif n == 16:
         edges = kagome_16_edges()
+    elif n == 36:
+        edges = kagome_36_edges()
     else:
         raise ValueError(f"no kagome cluster with {n} sites")
     basis = SpinBasis(n, n // 2)

@@ -9,7 +9,8 @@ import pytest
 import dense_ref
 from distributed_matvec_tpu.models.expression import parse_expression
 from distributed_matvec_tpu.models.lattices import (
-    chain_edges, j1j2_square, square_diagonal_edges, square_edges,
+    chain_edges, j1j2_square, kagome_36_edges, kagome_torus_edges,
+    pyrochlore_edges, square_diagonal_edges, square_edges,
     transverse_field_ising_chain, xxz_chain)
 from distributed_matvec_tpu.parallel.engine import LocalEngine
 from distributed_matvec_tpu.solve import lanczos
@@ -27,6 +28,62 @@ def _dense(op, exprs):
     return dense_ref.projected_matrix(
         op.basis.number_spins, h_full, op.basis.representatives,
         op.basis.norms, op.basis.group)
+
+
+def test_kagome_torus_structure():
+    """Periodic kagome clusters (the benchmark-kagome-36 geometry): every
+    site coordination-4, bond count 6 per unit cell, 36 sites at 4×3."""
+    for lx, ly in ((4, 3), (3, 4), (3, 3)):
+        edges = kagome_torus_edges(lx, ly)
+        n = 3 * lx * ly
+        deg = np.zeros(n, int)
+        for i, j in edges:
+            assert 0 <= i < n and 0 <= j < n and i != j
+            deg[i] += 1
+            deg[j] += 1
+        assert (deg == 4).all()
+        assert len(edges) == 6 * lx * ly
+    assert len(kagome_36_edges()) == 72
+    assert max(max(e) for e in kagome_36_edges()) == 35
+
+
+def test_pyrochlore_structure():
+    """Periodic pyrochlore (benchmark-pyrochlore-2x2x2 geometry): every
+    site coordination-6, 12 bonds per 4-site cell, 32 sites at 2×2×2."""
+    edges = pyrochlore_edges(2, 2, 2)
+    n = 32
+    deg = np.zeros(n, int)
+    for i, j in edges:
+        assert 0 <= i < n and 0 <= j < n and i != j
+        deg[i] += 1
+        deg[j] += 1
+    assert (deg == 6).all()
+    assert len(edges) == 96
+
+
+@pytest.mark.parametrize("name,n,edges", [
+    ("kagome_2x2", 12, kagome_torus_edges(2, 2)),
+    ("pyrochlore_1x1x1", 4, pyrochlore_edges(1, 1, 1)),
+])
+def test_torus_lattices_vs_independent(name, n, edges):
+    """σ-Heisenberg on the small periodic clusters against the independent
+    bit-op apply (wrap-doubled bonds carried identically by both sides)."""
+    from independent_ref import enumerate_fixed_hw, heisenberg_apply
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.yaml_io import operator_from_dict
+
+    basis = SpinBasis(number_spins=n, hamming_weight=n // 2)
+    op = operator_from_dict({"terms": [{
+        "expression": "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁",
+        "sites": [[i, j] for i, j in edges]}]}, basis)
+    basis.build()
+    states = enumerate_fixed_hw(n, n // 2)
+    x = np.random.default_rng(13).standard_normal(states.size)
+    y_ind = heisenberg_apply(states, edges, x)
+    np.testing.assert_allclose(op.matvec_host(x), y_ind,
+                               atol=ATOL, rtol=RTOL)
+    np.testing.assert_allclose(np.asarray(LocalEngine(op).matvec(x)), y_ind,
+                               atol=ATOL, rtol=RTOL)
 
 
 @pytest.mark.parametrize("delta", [0.0, 0.5, 2.5])

@@ -25,6 +25,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# A 63M-state apply on an oversubscribed virtual CPU mesh reaches its
+# all-reduce with ~30+ s of arrival skew (devices execute serially on few
+# cores); XLA's default 40 s rendezvous termination then kills the run.
+# Must be in XLA_FLAGS before jax initializes.
+if "xla_cpu_collective_call_terminate_timeout_seconds" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+
 
 def log(phase, **kv):
     print(json.dumps({"phase": phase, **kv}), flush=True)
