@@ -117,6 +117,20 @@ if shards_path:
     y3 = eng3.from_hashed(eng3.matvec(eng3.to_hashed(x)))
     assert float(np.abs(y3 - y2).max()) == 0.0
 
+    # PARTIAL cache: drop one rank's sidecar — restore must be refused on
+    # EVERY rank (all-or-nothing agreement), not hang half the job in the
+    # rebuild's collectives
+    from jax.experimental import multihost_utils
+
+    if pid == 1:
+        _os.remove(f"{cache}.dist{4 * nproc}.structure.h5.r1")
+    multihost_utils.sync_global_devices("partial_cache_ready")
+    eng4 = make_engine()
+    assert not eng4.structure_restored
+    y4 = eng4.from_hashed(eng4.matvec(eng4.to_hashed(x)))
+    assert float(np.abs(y4 - y2).max()) == 0.0
+    print(f"[p{pid}] partial-cache rebuild agreed", flush=True)
+
     # budget-truncated solve checkpoints per shard, rerun resumes
     v0 = eng3.random_hashed(seed=4)
     part = lanczos(eng3.matvec, v0=v0, k=1, tol=1e-12, max_iters=12,

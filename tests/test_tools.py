@@ -22,6 +22,27 @@ def _run(args, tmp_path):
             if line.startswith("{")]
 
 
+def test_sharded_enum_scale_ranks_cli(tmp_path):
+    """sharded_enum_scale --ranks: the multi-process enumeration CLI path
+    end-to-end (2 spawned ranks, finalize, census) on a small config; a
+    rerun restores every part."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="true")
+    out = str(tmp_path / "s16.h5")
+    cmd = [sys.executable,
+           os.path.join(REPO, "tools", "sharded_enum_scale.py"),
+           "--config", "heisenberg_chain_16", "--out", out,
+           "--shards", "4", "--ranks", "2", "--threads-per-rank", "1"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CENSUS_OK" in r.stdout
+    assert os.path.exists(out) and os.path.exists(out + ".part1")
+    r2 = subprocess.run(cmd, capture_output=True, text=True, timeout=420,
+                        env=env, cwd=REPO)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "restored" in r2.stdout and "CENSUS_OK" in r2.stdout
+
+
 def test_scale_bench_end_to_end(tmp_path):
     phases = _run(["--mode", "compact"], tmp_path)
     by = {p["phase"]: p for p in phases}
