@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""End-to-end SHARD-NATIVE pipeline: no global array at any stage.
+
+The ≥10⁹-state workflow (the reference's distributed-memory regime,
+README.md:69-116) at demo size:
+
+  1. enumerate the sector straight into per-shard datasets — optionally
+     with several OS processes, each streaming its cyclic chunk set into
+     its own part file (StatesEnumeration.chpl:321-334 analog),
+  2. census-validate the union (pure combinatorics, shares nothing with
+     the enumeration kernels),
+  3. build a plan-mode DistributedEngine from the shard file (peer shards
+     are streamed from disk one at a time; per-shard structure cache),
+  4. solve in hashed space with mid-solve checkpointing,
+  5. save eigenvectors per shard (vector_shards/eigenvector_i).
+
+Usage:
+    python examples/example_sharded_pipeline.py --num-spins 16 --ranks 2
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _enum_rank(args):
+    n, hw, n_shards, path, rank, n_ranks = args
+    from distributed_matvec_tpu.enumeration.sharded import enumerate_to_shards
+    from distributed_matvec_tpu.models.basis import SpinBasis
+
+    b = SpinBasis(number_spins=n, hamming_weight=hw)
+    man = enumerate_to_shards(n, hw, b.group, n_shards, path,
+                              rank=rank, n_ranks=n_ranks)
+    return man["total"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-spins", type=int, default=16)
+    ap.add_argument("--ranks", type=int, default=2,
+                    help="enumerating OS processes")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--mode", default="compact",
+                    choices=("ell", "compact", "fused"))
+    ap.add_argument("--k", type=int, default=2, help="eigenpairs")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+
+    # a plain CPU host exposes one device; virtualize the mesh before any
+    # backend init (harmless when real accelerators provide the devices)
+    if "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+
+    n, hw = args.num_spins, args.num_spins // 2
+    wd = args.workdir or tempfile.mkdtemp(prefix="dmt_sharded_")
+    shards = os.path.join(wd, "shards.h5")
+    print(f"workdir: {wd}")
+
+    from distributed_matvec_tpu.enumeration.sharded import finalize_shard_parts
+    from distributed_matvec_tpu.io.sharded_io import (hashed_vector_counts,
+                                                      save_hashed_vectors)
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.solve import lanczos
+
+    # 1+2: multi-process enumeration + census-validated finalize
+    t0 = time.time()
+    basis_spec = SpinBasis(number_spins=n, hamming_weight=hw)
+    if args.ranks > 1:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+
+        ctx = mp.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=args.ranks,
+                                 mp_context=ctx) as ex:
+            totals = list(ex.map(_enum_rank, [
+                (n, hw, args.devices, shards, r, args.ranks)
+                for r in range(args.ranks)]))
+        man = finalize_shard_parts(n, hw, basis_spec.group, args.devices,
+                                   shards, args.ranks)
+        print(f"enumerated {man['total']} representatives "
+              f"({args.ranks} ranks, per-rank {totals}) "
+              f"in {time.time() - t0:.1f} s — census OK")
+    else:
+        _enum_rank((n, hw, args.devices, shards, 0, 1))
+        print(f"enumerated in {time.time() - t0:.1f} s")
+
+    # 3: plan-mode engine straight from the shard file (+ per-shard cache);
+    # the same (unbuilt) basis spec the census used carries the operator
+    op = heisenberg_from_edges(basis_spec, chain_edges(n))
+    t0 = time.time()
+    eng = DistributedEngine.from_shards(
+        op, shards, n_devices=args.devices, mode=args.mode,
+        structure_cache=os.path.join(wd, "plan"))
+    assert not op.basis.is_built          # the global basis never exists
+    print(f"{args.mode} engine from shards in {time.time() - t0:.1f} s "
+          f"(N={eng.n_states}, restored={eng.structure_restored})")
+
+    # 4: hashed-space solve with mid-solve checkpointing
+    t0 = time.time()
+    res = lanczos(eng.matvec, v0=eng.random_hashed(seed=42), k=args.k,
+                  tol=1e-10, compute_eigenvectors=True,
+                  checkpoint_path=os.path.join(wd, "solver.h5"))
+    print(f"lanczos: {res.num_iters} iters in {time.time() - t0:.1f} s, "
+          f"converged={res.converged}")
+    for i, (w, r) in enumerate(zip(res.eigenvalues, res.residual_norms)):
+        print(f"  E[{i}] = {w:.12f}   residual {r:.2e}")
+
+    # 5: per-shard eigenvector output
+    out = os.path.join(wd, "eigen.h5")
+    save_hashed_vectors(out, {f"eigenvector_{i}": v
+                              for i, v in enumerate(res.eigenvectors)},
+                        eng.counts)
+    print(f"eigenvectors saved per shard to {out} "
+          f"(counts {list(map(int, hashed_vector_counts(out)))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,24 @@ def test_sharded_enum_scale_ranks_cli(tmp_path):
     assert "restored" in r2.stdout and "CENSUS_OK" in r2.stdout
 
 
+def test_example_sharded_pipeline(tmp_path):
+    """The shard-native pipeline example must keep running end to end
+    (2-rank enumeration → census → compact from_shards → solve →
+    per-shard eigenvector save); E0 is pinned to the chain_16 anchor."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="true",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "example_sharded_pipeline.py"),
+         "--num-spins", "16", "--ranks", "2",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-1500:])
+    assert "census OK" in r.stdout
+    assert "E[0] = -28.5691854" in r.stdout       # 4 × (−7.1422963606)
+    assert "saved per shard" in r.stdout
+
+
 def test_scale_bench_end_to_end(tmp_path):
     phases = _run(["--mode", "compact"], tmp_path)
     by = {p["phase"]: p for p in phases}
