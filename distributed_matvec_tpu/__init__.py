@@ -37,6 +37,14 @@ try:
     # examples, library users) off the TPU tunnel.
     if _os.environ.get("JAX_PLATFORMS"):
         _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    # Raise XLA's 40 s CPU collective rendezvous kill-switch up front (it
+    # only takes effect if no backend is built yet): big applies on an
+    # oversubscribed virtual CPU mesh legitimately skew past 40 s, and the
+    # flag cannot be set after the fact — see
+    # utils/config.py::ensure_cpu_collective_timeout.
+    from .utils.config import ensure_cpu_collective_timeout as _ect
+
+    _ect()
 except ImportError:  # pragma: no cover - jax is a hard dep in practice
     pass
 

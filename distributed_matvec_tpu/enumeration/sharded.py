@@ -40,7 +40,7 @@ from .host import shard_index
 from ..utils.logging import log_debug
 
 __all__ = ["enumerate_to_shards", "load_shard", "shard_manifest",
-           "finalize_shard_parts"]
+           "finalize_shard_parts", "reshard_shards"]
 
 _CHUNK = 1 << 20     # h5py append granularity (8 MB of u64)
 
@@ -293,6 +293,119 @@ def shard_manifest(path: str) -> Optional[dict]:
             return man
     except OSError:
         return None
+
+
+def reshard_shards(src_path: str, dst_path: str, n_shards: int,
+                   group=None, norm_tol: float = 1e-12) -> dict:
+    """Re-route an existing shard file onto a different shard count.
+
+    The mesh size is baked into a shard file (``hash64(state) % D`` owns a
+    state — StatesEnumeration.chpl:129-136), so running the same basis on a
+    different device count would otherwise force a full re-enumeration.
+    This streams the old shards into a new file instead: new shard ``d``
+    collects every state with ``hash64 % n_shards == d`` from each old
+    shard and merge-sorts them (old shards are sorted, so the filtered
+    streams are too).  When ``n_shards`` divides the old count, old shard
+    ``o`` can only feed new shard ``o % n_shards`` — the scan skips the
+    rest, halving the I/O for the common 8→4 case.  Peak memory is one old
+    shard plus one new shard, never the global array.
+
+    With ``group`` the new file carries the exact fingerprint a direct
+    enumeration at ``n_shards`` would (restore-compatible); without it a
+    derived ``reshard(<old_fp>, D)`` fingerprint still keys structure
+    caches uniquely.  The total is validated against the source manifest.
+    """
+    import h5py
+
+    man = shard_manifest(src_path)
+    if man is None:
+        raise ValueError(f"no shard manifest at {src_path}")
+    old_D = man["n_shards"]
+    with h5py.File(src_path, "r") as f:
+        n_sites = int(f.attrs["n_sites"])
+        hamming_weight = int(f.attrs["hamming_weight"])
+    if hamming_weight < 0:
+        hamming_weight = None
+    if group is not None:
+        # the caller's group is about to be stamped into a fingerprint a
+        # direct enumeration would trust — verify it actually IS the
+        # source file's sector first (total-vs-manifest below is
+        # group-independent and cannot catch a wrong momentum sector)
+        want_src = _fingerprint(n_sites, hamming_weight, group, old_D,
+                                norm_tol)
+        if man["fingerprint"] != want_src:
+            raise ValueError(
+                "the given symmetry group does not match the source shard "
+                f"file at {src_path} (fingerprint mismatch) — pass the "
+                "group the file was enumerated with, or omit it to get a "
+                "derived reshard fingerprint")
+        fp = _fingerprint(n_sites, hamming_weight, group, n_shards, norm_tol)
+    else:
+        fp = hashlib.sha256(
+            f"reshard({man['fingerprint']},{n_shards})".encode()).hexdigest()
+    existing = shard_manifest(dst_path)
+    if existing is not None and existing.get("fingerprint") == fp:
+        log_debug(f"reshard manifest restored from {dst_path}")
+        return existing
+    counts = np.zeros(n_shards, np.int64)
+    tmp = dst_path + ".tmp"
+    with h5py.File(tmp, "w") as fout:
+        # pass 1: ONE scan of the source — each old shard is read once and
+        # its rows appended to the owning new shards' growable datasets
+        dsets = []
+        for d_new in range(n_shards):
+            g = fout.create_group(f"shards/{d_new}")
+            dsets.append((
+                g.create_dataset("representatives", shape=(0,),
+                                 maxshape=(None,), dtype=np.uint64,
+                                 chunks=(_CHUNK,)),
+                g.create_dataset("norms", shape=(0,), maxshape=(None,),
+                                 dtype=np.float64, chunks=(_CHUNK,))))
+        for d_old in range(old_D):
+            s, w = load_shard(src_path, d_old)
+            own = shard_index(s, n_shards)
+            order = np.argsort(own, kind="stable")
+            bounds = np.searchsorted(own[order], np.arange(n_shards + 1))
+            for d_new in range(n_shards):
+                lo, hi = bounds[d_new], bounds[d_new + 1]
+                if lo == hi:
+                    continue
+                ds, dn = dsets[d_new]
+                o = ds.shape[0]
+                ds.resize((o + hi - lo,))
+                dn.resize((o + hi - lo,))
+                ds[o:] = s[order[lo:hi]]
+                dn[o:] = w[order[lo:hi]]
+                counts[d_new] += hi - lo
+            log_debug(f"reshard: routed old shard {d_old} ({s.size} states)")
+        # pass 2: appends from successive old shards interleave in state
+        # space — restore each new shard's sorted order (one new shard in
+        # memory at a time; old shards were sorted, so this is a k-way
+        # merge done as a stable argsort)
+        for d_new in range(n_shards):
+            ds, dn = dsets[d_new]
+            s = ds[...]
+            if s.size and not (s[:-1] <= s[1:]).all():
+                order = np.argsort(s, kind="stable")
+                ds[:] = s[order]
+                dn[:] = dn[...][order]
+            log_debug(f"reshard: new shard {d_new} holds {s.size} states")
+        total = int(counts.sum())
+        if total != man["total"]:
+            raise RuntimeError(
+                f"reshard routed {total} states, source manifest says "
+                f"{man['total']} — hash routing disagrees with the source")
+        fout.attrs["n_shards"] = n_shards
+        fout.attrs["counts"] = counts
+        fout.attrs["total"] = total
+        fout.attrs["n_sites"] = n_sites
+        fout.attrs["hamming_weight"] = -1 if hamming_weight is None \
+            else int(hamming_weight)
+        fout.attrs["fingerprint"] = fp
+    os.replace(tmp, dst_path)
+    log_debug(f"reshard: {old_D} → {n_shards} shards at {dst_path}")
+    return {"counts": counts.tolist(), "total": total, "fingerprint": fp,
+            "n_shards": n_shards, "restored": False}
 
 
 def load_shard(path: str, d: int):

@@ -191,10 +191,17 @@ def save_hashed_vectors(path: str, vectors: dict, counts,
             os.remove(tmp)
 
 
-def load_hashed_meta(path: str) -> Optional[dict]:
+def load_hashed_meta(path: str,
+                     expected_fingerprint: Optional[str] = None
+                     ) -> Optional[dict]:
     """The ``/ckpt_meta`` group of a hashed-vector file (attrs + datasets),
     searched across ``path`` and any per-rank ``path.r*`` files; None when
-    absent."""
+    absent.
+
+    ``expected_fingerprint`` keeps the scan going past candidates whose
+    ``fingerprint`` attr doesn't match — without it, a stale base-path file
+    left by an earlier single-process run would mask valid per-rank ``.r*``
+    checkpoints and a resume would silently start fresh."""
     import glob
     import h5py
 
@@ -202,6 +209,8 @@ def load_hashed_meta(path: str) -> Optional[dict]:
         try:
             with h5py.File(cand, "r") as f:
                 if "ckpt_meta" not in f:
+                    continue
+                if not _fingerprint_ok(f, expected_fingerprint):
                     continue
                 g = f["ckpt_meta"]
                 out = {k: g.attrs[k] for k in g.attrs}
@@ -213,10 +222,28 @@ def load_hashed_meta(path: str) -> Optional[dict]:
     return None
 
 
-def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:
+def _fingerprint_ok(f, expected_fingerprint: Optional[str]) -> bool:
+    """True when ``expected_fingerprint`` is unset or matches the file's
+    ``/ckpt_meta`` fingerprint attr — the filter that keeps a stale
+    base-path file from an earlier run from shadowing valid per-rank
+    ``.r*`` files in the scans below."""
+    if expected_fingerprint is None:
+        return True
+    if "ckpt_meta" not in f:
+        return False
+    return (str(f["ckpt_meta"].attrs.get("fingerprint", ""))
+            == expected_fingerprint)
+
+
+def load_hashed_shard(path: str, d: int, name: str = "v",
+                      expected_fingerprint: Optional[str] = None
+                      ) -> np.ndarray:
     """One shard's rows of a saved hashed vector (pad rows NOT included).
     Looks in ``path`` first, then in any per-rank ``path.r*`` files a
-    multi-process save produced."""
+    multi-process save produced; ``expected_fingerprint`` skips files whose
+    ``/ckpt_meta`` fingerprint differs (checkpoint consumers MUST pass it —
+    otherwise a stale base-path file shadows the valid per-rank data its
+    metadata was already fingerprint-matched against)."""
     import glob
     import h5py
 
@@ -224,6 +251,8 @@ def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:
     for cand in [path] + sorted(glob.glob(f"{path}.r*")):
         try:
             with h5py.File(cand, "r") as f:
+                if not _fingerprint_ok(f, expected_fingerprint):
+                    continue
                 if key in f and str(d) in f[key]:
                     return f[key][str(d)][...]
         except OSError:
@@ -231,11 +260,23 @@ def load_hashed_shard(path: str, d: int, name: str = "v") -> np.ndarray:
     raise KeyError(f"shard {d} of {name!r} not found under {path}(.r*)")
 
 
-def hashed_vector_counts(path: str) -> Optional[np.ndarray]:
+def hashed_vector_counts(path: str,
+                         expected_fingerprint: Optional[str] = None
+                         ) -> Optional[np.ndarray]:
+    """The ``counts`` attr of a hashed-vector file, searched across ``path``
+    and any per-rank ``path.r*`` files (a multi-process save writes only to
+    ``path.r<rank>``; every rank's file carries the full counts array).
+    ``expected_fingerprint`` applies the same stale-file filter as
+    :func:`load_hashed_shard`."""
+    import glob
     import h5py
 
-    try:
-        with h5py.File(path, "r") as f:
-            return np.asarray(f.attrs["counts"], np.int64)
-    except (OSError, KeyError):
-        return None
+    for cand in [path] + sorted(glob.glob(f"{path}.r*")):
+        try:
+            with h5py.File(cand, "r") as f:
+                if not _fingerprint_ok(f, expected_fingerprint):
+                    continue
+                return np.asarray(f.attrs["counts"], np.int64)
+        except (OSError, KeyError):
+            continue
+    return None

@@ -67,7 +67,9 @@ from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import (SENTINEL_STATE, check_complex_backend, choose_ell_split,
+from .engine import (SENTINEL_STATE, attach_traced_counter_check,
+                     check_complex_backend, choose_ell_split,
+                     raise_deferred_failure,
                      compact_magnitude, unroll_terms_ok, use_pair_complex)
 from .mesh import SHARD_AXIS, make_mesh, shard_spec
 from .shuffle import HashedLayout
@@ -220,6 +222,8 @@ class DistributedEngine:
         self._checked: set = set()
         self._last_program_key = None
         self._last_capacity: Optional[int] = None
+        self._warned_traced_check = False
+        self._deferred_failure: Optional[str] = None
 
         # Row provider for the plan builds: this process's shards come from
         # the rows already loaded above; PEER shards are fetched on demand
@@ -1407,6 +1411,49 @@ class DistributedEngine:
         nrm = jax.jit(col_norm)(xh)
         return jax.jit(jnp.divide)(xh, nrm)
 
+    def state_keyed_hashed(self, salt: int = 0):
+        """Deterministic probe vector keyed by STATE VALUE, not shard slot.
+
+        ``x[state] = hash64(state XOR salt)/2⁶⁴ − ½`` — a pure function of
+        the basis state, so two engines over the same sector on DIFFERENT
+        mesh sizes (or shard partitions, e.g. an 8-shard file vs its
+        :func:`~..enumeration.sharded.reshard_shards` 4-shard copy) hold
+        the identical global vector.  That makes cross-mesh invariants
+        (⟨x, Hx⟩, ‖Hx‖) directly comparable — the verification probe for
+        scale runs where no global array can exist.  Pads are zero; pair
+        engines get an independent imaginary part (salt+1)."""
+        from ..enumeration.host import hash64, shard_index
+
+        D, M = self.n_devices, self.shard_size
+        tail = (2,) if self.pair else ()
+
+        def keyed(reps, s):
+            with np.errstate(over="ignore"):     # u64 wrap is the point
+                mix = np.uint64(0x9E3779B97F4A7C15) * np.uint64(s + 1)
+            h = hash64(reps ^ mix)
+            return h.astype(np.float64) / 2.0 ** 64 - 0.5
+
+        if self._shards_path is None:
+            reps_global = self.operator.basis.representatives
+            owners = shard_index(reps_global, D)
+        rows = [None] * D
+        for d in range(D):
+            if not self._shard_addressable(d):
+                continue
+            if self._shards_path is not None:
+                from ..enumeration.sharded import load_shard
+                reps = load_shard(self._shards_path, d)[0]
+            else:
+                reps = reps_global[owners == d]
+            x = np.zeros((M,) + tail)
+            if self.pair:
+                x[: reps.size, 0] = keyed(reps, salt)
+                x[: reps.size, 1] = keyed(reps, salt + 1)
+            else:
+                x[: reps.size] = keyed(reps, salt)
+            rows[d] = x
+        return self._assemble_sharded(rows)
+
     def matvec(self, xh, check: Optional[bool] = None) -> jax.Array:
         """y = H·x in hashed layout ([D, M] or [D, M, k]).
 
@@ -1421,29 +1468,52 @@ class DistributedEngine:
                     f"pair-mode engine expects hashed [D, M, 2] or "
                     f"[D, M, k, 2] (re, im) f64 vectors, got {xh.shape}"
                 )
+            raise_deferred_failure(self)
             y, overflow, invalid = self._matvec(xh)
             key = self._last_program_key
             if isinstance(overflow, jax.core.Tracer):
                 # called under an outer trace (e.g. lobpcg_standard's
-                # while_loop): the counters are abstract — defer validation
-                # to the next eager call (callers' eager probes run first)
+                # while_loop): the counters are abstract.  Validation still
+                # happens — at RUN time on the concrete counters, see
+                # ``attach_traced_counter_check``.  The shipped solvers run
+                # an eager probe first (key already in ``_checked``),
+                # paying zero overhead; only never-probed program keys get
+                # the per-call callback.
+                if check is not False and key not in self._checked:
+                    attach_traced_counter_check(
+                        self,
+                        "DistributedEngine.matvec traced before any eager "
+                        "call with this program key: overflow/invalid "
+                        "counter validation runs via jax.debug.callback "
+                        "at execution time instead of raising inline; run "
+                        "one eager matvec first to validate up front",
+                        lambda o, i: self._validate_counters(o, i, key),
+                        lambda: self._checked.add(key),
+                        (overflow, invalid))
                 return y
             if check or (check is None and key not in self._checked):
-                if int(overflow):
-                    cap = (self._last_capacity if self._last_capacity
-                           is not None else getattr(self, "_capacity", None))
-                    raise RuntimeError(
-                        f"{int(overflow)} amplitudes overflowed the all_to_all "
-                        f"capacity {cap} (program chunk {key}); raise "
-                        "remote_buffer_size or all_to_all_capacity_factor"
-                    )
-                if int(invalid):
-                    raise RuntimeError(
-                        f"{int(invalid)} generated amplitudes map outside the "
-                        "basis — operator does not preserve the chosen sector"
-                    )
+                self._validate_counters(int(overflow), int(invalid), key)
                 self._checked.add(key)
         return y
+
+    def _validate_counters(self, overflow: int, invalid: int, key) -> None:
+        """Raise loudly when the drain counters report lost amplitudes —
+        the analog of the reference's blocking-buffer halt
+        (DistributedMatrixVector.chpl:113-118)."""
+        if overflow:
+            cap = (self._last_capacity if self._last_capacity
+                   is not None else getattr(self, "_capacity", None))
+            raise RuntimeError(
+                f"{overflow} amplitudes overflowed the all_to_all "
+                f"capacity {cap} (program chunk {key}); raise "
+                "remote_buffer_size or all_to_all_capacity_factor"
+            )
+        if invalid:
+            raise RuntimeError(
+                f"{invalid} generated amplitudes map outside the "
+                "basis — operator does not preserve the chosen sector"
+            )
+
 
     def matvec_global(self, x) -> np.ndarray:
         """Convenience: block-layout in/out (shuffle → matvec → unshuffle).

@@ -91,6 +91,49 @@ def choose_ell_split(hist: np.ndarray, n_rows: int, T: int,
     return T0, S, Tmax
 
 
+def raise_deferred_failure(eng) -> None:
+    """Re-raise (once) a counter-validation failure recorded by a traced
+    matvec's debug callback — shared by both engines' eager matvec entry
+    (see :func:`attach_traced_counter_check`)."""
+    if eng._deferred_failure is not None:
+        msg, eng._deferred_failure = eng._deferred_failure, None
+        raise RuntimeError(
+            "a previous traced matvec failed counter validation "
+            "(detected at run time via debug callback): " + msg)
+
+
+def attach_traced_counter_check(eng, message: str, validate, mark_checked,
+                                counters) -> None:
+    """Run-time counter validation for a matvec called under an OUTER trace.
+
+    The drain counters are tracers there, so the loud eager RuntimeError
+    cannot fire inline.  Instead: warn once (``message``) that validation
+    is deferred, then attach a ``jax.debug.callback`` that calls
+    ``validate(*ints)`` on the concrete counter values at execution time —
+    on success ``mark_checked()`` records the program as validated, on
+    failure the message is stored on ``eng._deferred_failure`` (re-raised
+    by the next eager matvec via :func:`raise_deferred_failure`, because a
+    callback's own exception cannot reliably stop the surrounding compiled
+    program) before propagating.  Shared by ``LocalEngine`` (one counter,
+    bool ``_checked``) and ``DistributedEngine`` (two counters, per-program
+    key set); the shipped solvers probe eagerly first and never attach it.
+    """
+    if not eng._warned_traced_check:
+        import warnings
+        warnings.warn(message, RuntimeWarning, stacklevel=4)
+        eng._warned_traced_check = True
+
+    def _cb(*vals):
+        try:
+            validate(*(int(v) for v in vals))
+        except RuntimeError as e:
+            eng._deferred_failure = str(e)
+            raise
+        mark_checked()
+
+    jax.debug.callback(_cb, *counters)
+
+
 def use_pair_complex(platform: str | None = None) -> bool:
     """Whether complex sectors should run in (re, im)-f64 pair form.
 
@@ -326,6 +369,8 @@ class LocalEngine:
         else:
             self._matvec = self._make_fused_matvec()
             self._checked = False
+        self._warned_traced_check = False
+        self._deferred_failure: Optional[str] = None
         self.timer.report()  # tree print, gated by display_timings
 
     # -- structure checkpoint (ell/compact) ---------------------------------
@@ -1018,21 +1063,39 @@ class LocalEngine:
                     f"pair-mode engine expects [N, 2] or [N, k, 2] (re, im) "
                     f"f64 vectors (or complex input), got shape {np.shape(x)}"
                 )
+            raise_deferred_failure(self)
             y, bad = self._matvec(jnp.asarray(x))
             if isinstance(bad, jax.core.Tracer):
-                # under an outer trace the counter is abstract — defer
-                # validation to the next eager call.  y is a tracer too,
-                # so it goes back unconverted (pair form) even for complex
-                # input; traced callers consume pair arrays natively.
+                # under an outer trace the counter is abstract.  y is a
+                # tracer too, so it goes back unconverted (pair form) even
+                # for complex input; traced callers consume pair arrays
+                # natively.  Validation still happens — at RUN time on the
+                # concrete counter, see ``attach_traced_counter_check`` —
+                # and engines validated at build time (``_checked`` True)
+                # pay nothing.
+                if check is not False and not self._checked:
+                    attach_traced_counter_check(
+                        self,
+                        "LocalEngine.matvec traced before any eager call: "
+                        "invalid-state counter validation runs via "
+                        "jax.debug.callback at execution time instead of "
+                        "raising inline; run one eager matvec first to "
+                        "validate up front",
+                        self._validate_counter,
+                        lambda: setattr(self, "_checked", True),
+                        (bad,))
                 return y
             if check or (check is None and not self._checked):
-                if int(bad) != 0:
-                    raise RuntimeError(
-                        f"{int(bad)} generated amplitudes map outside the basis "
-                        "— operator does not preserve the chosen sector"
-                    )
+                self._validate_counter(int(bad))
                 self._checked = True
         return K.complex_from_pair(np.asarray(y)) if was_complex else y
+
+    def _validate_counter(self, bad: int) -> None:
+        if bad != 0:
+            raise RuntimeError(
+                f"{bad} generated amplitudes map outside the basis "
+                "— operator does not preserve the chosen sector"
+            )
 
     def __call__(self, x):
         return self.matvec(x)

@@ -148,8 +148,10 @@ def _restore_ckpt(path, fp, owner, shape, sharded):
         return dict(got, V_rows=[jnp.asarray(r) for r in got["V"]])
     from ..io.sharded_io import load_hashed_meta, load_hashed_shard
 
-    meta = load_hashed_meta(path)
-    if meta is None or str(meta.get("fingerprint", "")) != fp:
+    # fingerprint-filtered scan: a stale base-path file from an earlier
+    # single-process run must not mask valid per-rank .r* checkpoints
+    meta = load_hashed_meta(path, expected_fingerprint=fp)
+    if meta is None:
         return None
     m = int(meta["m"])
     D, M = owner.n_devices, owner.shard_size
@@ -161,7 +163,8 @@ def _restore_ckpt(path, fp, owner, shape, sharded):
             for d in range(D):
                 if not owner._shard_addressable(d):
                     continue
-                r = load_hashed_shard(path, d, name=f"krylov_{i}")
+                r = load_hashed_shard(path, d, name=f"krylov_{i}",
+                                      expected_fingerprint=fp)
                 full = np.zeros((M,) + tuple(tail))
                 full[: r.shape[0]] = r
                 pieces[d] = full

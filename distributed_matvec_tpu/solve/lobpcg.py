@@ -204,7 +204,16 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
                 vh = w / lam
             sigma = 1.05 * lam
 
-            G = np.asarray(jax.jit(lambda A: A.T @ A)(U0))
+            # The [m, m] Gram must be FULLY REPLICATED before the host
+            # fetch: jit's default output sharding over a process-spanning
+            # operand is unspecified, and np.asarray raises on
+            # non-fully-addressable arrays.  Explicit replicated
+            # out_shardings makes the psum-reduced matmul land addressable
+            # on every process.
+            from jax.sharding import NamedSharding, PartitionSpec
+            _rep = NamedSharding(owner.mesh, PartitionSpec())
+            G = np.asarray(
+                jax.jit(lambda A: A.T @ A, out_shardings=_rep)(U0))
             L = np.linalg.cholesky(
                 G + 1e-12 * np.trace(G) * np.eye(G.shape[1]))
             Li = jnp.asarray(np.linalg.inv(L))

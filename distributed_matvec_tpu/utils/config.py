@@ -105,3 +105,35 @@ def update_config(**kwargs) -> RuntimeConfig:
             raise AttributeError(f"unknown config field {k!r}")
         setattr(cfg, k, v)
     return cfg
+
+
+def ensure_cpu_collective_timeout(seconds: int = 1200) -> bool:
+    """Raise XLA's CPU collective rendezvous termination timeout.
+
+    XLA's CPU runtime kills the whole process when collective participants
+    arrive more than 40 s apart ("Termination timeout ... exceeded").  On an
+    oversubscribed virtual-device CPU mesh — the multi-chip development
+    path of SURVEY.md §6, where N devices execute serially on few host
+    cores — a large apply (≥10⁷ states/shard) routinely has >40 s of
+    arrival skew, so the default kills runs that would finish fine.  The
+    flag must be in ``XLA_FLAGS`` before the CPU client is created, which
+    is why the package appends it at import time (harmless for TPU/GPU
+    backends: it only governs the CPU collective rendezvous).
+
+    Returns True when the flag is (now) present in ``XLA_FLAGS``; False
+    when a backend already initialised without it, in which case the
+    caller must re-exec to benefit (``DMT_`` env knobs can't help — this
+    is an XLA runtime flag, not an engine parameter).
+    """
+    flag = "xla_cpu_collective_call_terminate_timeout_seconds"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if flag in flags:
+        return True
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge._backends:        # too late: client already built
+            return False
+    except Exception:                   # private API moved: assume not yet
+        pass
+    os.environ["XLA_FLAGS"] = (flags + f" --{flag}={seconds}").strip()
+    return True

@@ -480,3 +480,39 @@ def test_distributed_scan_branch(mode, rng, monkeypatch):
     eng = DistributedEngine(op, n_devices=8, mode=mode, batch_size=32)
     np.testing.assert_allclose(eng.matvec_global(x), op.matvec_host(x),
                                atol=ATOL, rtol=RTOL)
+
+
+@needs_8
+def test_fused_overflow_detected_under_trace(rng):
+    """The distributed twin of the local traced-validation test (ADVICE
+    r4 medium): a jit-only caller hitting a too-small all_to_all capacity
+    gets a trace-time RuntimeWarning, run-time counter validation via
+    ``jax.debug.callback``, and a sticky RuntimeError from the next eager
+    matvec."""
+    import time
+
+    from distributed_matvec_tpu.utils.config import get_config, update_config
+
+    op = build_heisenberg(12, 6)
+    op.basis.build()
+    x = rng.random(op.basis.number_states) - 0.5
+    cfg = get_config()
+    saved = (cfg.all_to_all_capacity_factor, cfg.remote_buffer_size)
+    update_config(all_to_all_capacity_factor=1.0, remote_buffer_size=8)
+    try:
+        eng = DistributedEngine(op, n_devices=8, mode="fused",
+                                batch_size=128)
+        xh = eng.to_hashed(x)
+        with pytest.warns(RuntimeWarning, match="traced before any eager"):
+            try:
+                jax.block_until_ready(jax.jit(eng.matvec)(xh))
+            except Exception:
+                pass        # callback exception may surface through the jit
+        deadline = time.time() + 10
+        while eng._deferred_failure is None and time.time() < deadline:
+            time.sleep(0.05)
+        with pytest.raises(RuntimeError, match="overflow"):
+            eng.matvec(xh)
+    finally:
+        update_config(all_to_all_capacity_factor=saved[0],
+                      remote_buffer_size=saved[1])

@@ -391,3 +391,54 @@ def test_complex_on_tpu_guard(monkeypatch):
         check_complex_backend(False)             # override allows
     finally:
         update_config(allow_complex_on_tpu=prev)
+
+
+def test_traced_matvec_validates_via_callback():
+    """A caller that only ever runs ``engine.matvec`` under its own jit
+    (no eager probe) must still get loud sector-violation detection: a
+    one-time RuntimeWarning at trace time, run-time validation through
+    ``jax.debug.callback``, and a sticky failure re-raised by the next
+    eager matvec even when the runtime swallows the callback exception."""
+    import time
+
+    import jax
+
+    from distributed_matvec_tpu.models.operator import Operator
+
+    basis = SpinBasis(6, 3)
+    op = Operator.from_expressions(basis, [("σˣ₀", [[0], [1]])])
+    basis.build()
+    eng = LocalEngine(op, mode="fused")
+    x = np.ones(basis.number_states)
+    with pytest.warns(RuntimeWarning, match="traced before any eager"):
+        try:
+            jax.block_until_ready(jax.jit(eng.matvec)(x))
+        except Exception:
+            pass            # the callback's own exception may surface here
+    deadline = time.time() + 10         # callbacks may complete async
+    while eng._deferred_failure is None and time.time() < deadline:
+        time.sleep(0.05)
+    with pytest.raises(RuntimeError, match="outside the basis"):
+        eng.matvec(x)
+
+
+def test_traced_matvec_callback_marks_checked(rng):
+    """The positive side: a VALID operator traced first validates through
+    the callback and marks the engine checked — later eager calls skip
+    re-validation and match the eager result."""
+    import time
+
+    import jax
+
+    op = build_heisenberg(10, 5)
+    op.basis.build()
+    eng = LocalEngine(op, mode="fused", batch_size=32)
+    x = rng.random(op.basis.number_states) - 0.5
+    with pytest.warns(RuntimeWarning, match="traced before any eager"):
+        y = np.asarray(jax.jit(eng.matvec)(x))
+    deadline = time.time() + 10
+    while not eng._checked and time.time() < deadline:
+        time.sleep(0.05)
+    assert eng._checked and eng._deferred_failure is None
+    np.testing.assert_allclose(y, np.asarray(eng.matvec(x)),
+                               atol=ATOL, rtol=RTOL)

@@ -273,3 +273,14 @@ def test_lanczos_checkpoint_resume_restart_boundary(tmp_path):
     assert resumed.converged and resumed.num_iters > 40
     np.testing.assert_allclose(resumed.eigenvalues[0],
                                np.linalg.eigvalsh(A)[0], atol=1e-9)
+
+
+def test_lobpcg_private_api_present():
+    """Multi-process LOBPCG runs jax's UNJITTED lobpcg body under its own
+    jit (solve/lobpcg.py:100-107); that body is reached through the
+    private ``_lobpcg_standard_callable.__wrapped__``.  Pin the dependency
+    here so a jax upgrade that removes it fails CI loudly instead of
+    silently degrading the advertised capability to 'use lanczos'."""
+    from jax.experimental.sparse.linalg import _lobpcg_standard_callable
+
+    assert callable(getattr(_lobpcg_standard_callable, "__wrapped__", None))

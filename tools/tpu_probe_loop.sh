@@ -1,0 +1,16 @@
+#!/bin/sh
+# Background TPU-tunnel probe. Appends "TPU_UP <epoch>" / "TPU_DOWN <epoch>"
+# to /tmp/tpu_status.log every ~10 min. The probe runs jax in a killable
+# subprocess (the wedged tunnel blocks in C where signals cannot interrupt,
+# so `timeout -k` with a fresh session is mandatory — see bench.py:179-207).
+LOG=/tmp/tpu_status.log
+while true; do
+  if timeout -k 10 120 setsid python -c \
+      'import jax.numpy as jnp; assert float(jnp.arange(8.0).sum()) == 28.0' \
+      >/dev/null 2>&1; then
+    echo "TPU_UP $(date +%s)" >> "$LOG"
+  else
+    echo "TPU_DOWN $(date +%s)" >> "$LOG"
+  fi
+  sleep 580
+done
