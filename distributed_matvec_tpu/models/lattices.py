@@ -205,6 +205,33 @@ def heisenberg_square(nx: int, ny: int) -> Operator:
     return heisenberg_from_edges(basis, square_edges(nx, ny))
 
 
+def kagome_torus_translations(lx: int, ly: int,
+                              sector_x: int = 0, sector_y: int = 0
+                              ) -> List[Tuple[List[int], int]]:
+    """The two unit-cell translation generators of the ``lx × ly`` kagome
+    torus as (permutation, sector) pairs — the symmetry-adapted form of the
+    reference's commented kagome_36 workload (Makefile:85,108) at a basis
+    size this host can enumerate (|G| = lx·ly reduces the 4×3 torus's
+    C(36,18) ≈ 9.1·10⁹ hamming states to ≈ 7.6·10⁸ representatives).
+
+    Site labeling matches :func:`kagome_torus_edges`; the edge set is
+    manifestly invariant under both generators (cells translate, sublattice
+    index fixed), so any (sector_x, sector_y) momentum pair is a valid
+    symmetry sector of the Heisenberg model on this torus.
+    """
+    def site(x, y, s):
+        return 3 * ((y % ly) * lx + (x % lx)) + s
+
+    tx = [0] * (3 * lx * ly)
+    ty = [0] * (3 * lx * ly)
+    for y in range(ly):
+        for x in range(lx):
+            for s in range(3):
+                tx[site(x, y, s)] = site(x + 1, y, s)
+                ty[site(x, y, s)] = site(x, y + 1, s)
+    return [(tx, sector_x), (ty, sector_y)]
+
+
 def heisenberg_kagome(n: int) -> Operator:
     if n == 12:
         edges = kagome_12_edges()

@@ -43,6 +43,14 @@ if "xla_cpu_collective_call_terminate_timeout_seconds" \
         + os.environ.get("DMT_SCALE_RDV_TIMEOUT", "43200"))
 
 
+# The default kRemoteBufferSize-parity cap (150k) clips the per-peer
+# exchange capacity below the per-chunk mean at benchmark-scale term
+# counts (measured: chain_32_symm B=65536, T=32 needs ~165k) — the engine
+# then fails validation loudly.  Scale runs default the cap high; the
+# engine still sizes the actual buffers by mean×headroom when smaller.
+os.environ.setdefault("DMT_REMOTE_BUFFER_SIZE", "3000000")
+
+
 def log(phase, **kv):
     print(json.dumps({"phase": phase, **kv}), flush=True)
 
@@ -68,14 +76,22 @@ def main():
                          "backend name to NOT pin")
     args = ap.parse_args()
 
-    import jax
-
     if args.platform == "cpu":
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count="
                         f"{args.devices}")
+        # BOTH the env var and the config update, set before any backend
+        # touch: the accelerator plugin's get_backend hook consults the
+        # env var, and the sitecustomize's config force needs the config
+        # update — either alone still initializes the dead tunnel client
+        # (jax.default_backend() hangs in C).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
