@@ -32,7 +32,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard SET, not setdefault — see tools/independent_e0.py: the env may
+# already carry the accelerator platform name, and setdefault then lets
+# any backend touch wedge on the dead tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def log(phase, **kv):

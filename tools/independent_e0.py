@@ -31,7 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard SET, not setdefault: the environment may already carry the
+# accelerator platform name (observed), and the plugin's get_backend hook
+# consults the env var — a setdefault then lets the first jit wedge on the
+# dead tunnel (main thread nanosleep-retrying the client init)
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def log(phase, **kv):
