@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: check check-fast check-solve smoke dryrun bench clean
+.PHONY: check check-fast check-solve smoke dryrun bench warm-cache clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -23,6 +23,11 @@ dryrun:
 
 bench:
 	$(PYTHON) bench.py
+
+# Pre-build the artifact caches (basis / structure / XLA) for the bench
+# configs so engine construction in later processes is seconds, not minutes.
+warm-cache:
+	$(PYTHON) tools/warm_cache.py --configs cpu
 
 clean:
 	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null; true

@@ -67,11 +67,14 @@ from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import (SENTINEL_STATE, attach_traced_counter_check,
+from .engine import (SENTINEL_STATE, apply_diag_jit,
+                     attach_traced_counter_check,
                      check_complex_backend, choose_ell_split,
+                     gather_coefficients_jit, precompile,
                      raise_deferred_failure,
                      compact_magnitude, unroll_terms_ok, use_pair_complex)
-from .mesh import SHARD_AXIS, make_mesh, shard_spec
+from .mesh import (SHARD_AXIS, make_mesh, pcast_varying,
+                   shard_map_compat, shard_spec)
 from .shuffle import HashedLayout
 
 __all__ = ["DistributedEngine"]
@@ -109,6 +112,10 @@ class DistributedEngine:
                  layout: Optional[HashedLayout] = None,
                  shards_path: Optional[str] = None):
         basis = operator.basis
+        #: True when the representatives came from the artifact-cache
+        #: checkpoint rather than a fresh enumeration (always False for
+        #: shard-native and pre-built bases).
+        self.basis_restored = False
         cfg = get_config()
         mode = mode or cfg.matvec_mode
         if mode not in ("ell", "compact", "fused"):
@@ -163,7 +170,8 @@ class DistributedEngine:
                 return a, nn
         else:
             if not basis.is_built:
-                basis.build()
+                from ..utils.artifacts import make_or_restore_basis
+                self.basis_restored = make_or_restore_basis(basis)
             reps, norms = basis.representatives, basis.norms
             # several engines over the SAME basis (H + observables) can
             # share one layout: the hash partition is a pure function of
@@ -189,24 +197,27 @@ class DistributedEngine:
 
         self.shard_size = M
         self.counts = counts
-        self.tables = K.device_tables(operator, pair=self.pair)
+        from ..utils.artifacts import ensure_compilation_cache
+        ensure_compilation_cache()
+        with self.timer.scope("transfer"):
+            self.tables = K.device_tables(operator, pair=self.pair)
         self.num_terms = int(self.tables.off.x.shape[0])
         self._sh1 = shard_spec(self.mesh, 2)
         self._sh2 = shard_spec(self.mesh, 3)
 
         # Per-shard sorted representative/norm/diag rows ([M], SENTINEL
         # pad), shipped to their device one shard at a time; this process
-        # loads only its addressable shards.
+        # loads only its addressable shards.  The diag program is the
+        # process-wide shared one — no per-engine retrace.
         alpha_rows = [None] * D
         norm_rows = [None] * D
         diag_rows = [None] * D
-        diag_fn = jax.jit(K.apply_diag)
         for d in range(D):
             if not self._shard_addressable(d):
                 continue
             a, w = shard_rows(d)
             alpha_rows[d], norm_rows[d] = a, w
-            dd = np.asarray(diag_fn(self.tables.diag, jnp.asarray(a)))
+            dd = np.asarray(apply_diag_jit(self.tables.diag, jnp.asarray(a)))
             diag_rows[d] = np.where(a != SENTINEL_STATE, dd, 0.0)
         self._alphas = self._assemble_sharded(alpha_rows)
         self._norms = self._assemble_sharded(norm_rows)
@@ -243,20 +254,36 @@ class DistributedEngine:
             rank restored."""
             if jax.process_count() == 1:
                 return restored
-            from jax.experimental import multihost_utils as mhu
-            return bool(int(np.min(mhu.process_allgather(
-                np.int32(restored)))))
+            # ALWAYS join the collective when multi-process — a rank whose
+            # cache root failed to resolve (structure_cache None) must still
+            # meet the others at the allgather or the job hangs here
+            try:
+                from jax.experimental import multihost_utils as mhu
+                return bool(int(np.min(mhu.process_allgather(
+                    np.int32(restored)))))
+            except Exception as e:
+                # backends without multiprocess host computations (the CPU
+                # DCN test rig): the conservative agreement is a rebuild on
+                # every rank — the same deterministic answer everywhere, so
+                # the _plan_stream collectives stay aligned
+                log_debug(f"restore agreement unavailable ({e!r}); "
+                          "rebuilding on all ranks")
+                return False
 
-        #: True when the plan came from a ``structure_cache`` restore rather
-        #: than a fresh host-coordinated build.
+        #: True when the plan came from a ``structure_cache`` restore
+        #: (explicit path or the default artifact cache) rather than a
+        #: fresh host-coordinated build.
         self.structure_restored = False
+        soft_save = structure_cache is None
+        if mode in ("ell", "compact"):
+            structure_cache = self._resolve_structure_cache(structure_cache)
         if mode == "ell":
             self.structure_restored = agree_restored(
                 self._try_load_structure(structure_cache))
             if not self.structure_restored:
                 with self.timer.scope("build_plan"):
                     self._plan_stream(row_provider, compact=False)
-                self._save_structure(structure_cache)
+                self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_ell_matvec()
             self._checked.add(None)  # static plan: no data-dependent capacity
         elif mode == "compact":
@@ -297,7 +324,7 @@ class DistributedEngine:
                 self._c_W = float(vals[0]) if vals.size else 0.0
                 with self.timer.scope("build_plan"):
                     self._plan_stream(row_provider, compact=True)
-                self._save_structure(structure_cache)
+                self._save_structure(structure_cache, soft=soft_save)
                 self._c_n_all_shards = None   # only needed by the save above
             self._matvec = self._make_compact_matvec()
             self._checked.add(None)  # static plan: no data-dependent capacity
@@ -473,15 +500,25 @@ class DistributedEngine:
         Bc = min(M, max(self.batch_size, 8))
         nchunks = (M + Bc - 1) // Bc
 
-        @jax.jit
-        def gather_chunk(tables, alphas, norms_a):
-            return K.gather_coefficients(tables, alphas, norms_a)
+        # ONE fixed-shape gather program (every chunk is padded to Bc rows),
+        # AOT-compiled once per (shapes, pair) process-wide and shared with
+        # any other engine build over the same shapes; compile time lands in
+        # the timer's `compile` scope under `build_plan`.
+        gather_chunk = precompile(
+            "dist_gather_chunk", (self.pair,),
+            gather_coefficients_jit,
+            (self.tables, jnp.zeros(Bc, jnp.uint64), jnp.ones(Bc)),
+            self.timer)
 
         def chunks(d):
-            """Yield (s, e, n_c, betas, cf, nz) per row chunk, all
-            padded to Bc rows (SENTINEL rows carry cf == 0)."""
+            """Yield (s, e, n_c, betas, cf, nz) per row chunk, all padded
+            to Bc rows (SENTINEL rows carry cf == 0).  Double-buffered:
+            chunk ci+1's upload + device pass is dispatched before chunk
+            ci's results are fetched, so the device computes ahead while
+            the host runs the routing math."""
             a_d, nn_d = row_provider(d)
-            for ci in range(nchunks):
+
+            def launch(ci):
                 s, e = ci * Bc, min((ci + 1) * Bc, M)
                 a_c, n_c = a_d[s:e], nn_d[s:e]
                 if e - s < Bc:
@@ -489,14 +526,23 @@ class DistributedEngine:
                         [a_c, np.full(Bc - (e - s), SENTINEL_STATE,
                                       np.uint64)])
                     n_c = np.concatenate([n_c, np.ones(Bc - (e - s))])
-                betas_d, cf_d = gather_chunk(
-                    self.tables, jnp.asarray(a_c), jnp.asarray(n_c))
-                betas, cf = np.asarray(betas_d), np.asarray(cf_d)
+                with self.timer.scope("transfer"):
+                    a_dev, n_dev = jnp.asarray(a_c), jnp.asarray(n_c)
+                return s, e, a_c, n_c, gather_chunk(self.tables, a_dev,
+                                                    n_dev)
+
+            pending = launch(0) if nchunks else None
+            for ci in range(nchunks):
+                nxt = launch(ci + 1) if ci + 1 < nchunks else None
+                s, e, a_c, n_c, (betas_d, cf_d) = pending
+                with self.timer.scope("transfer"):
+                    betas, cf = np.asarray(betas_d), np.asarray(cf_d)
                 if self.pair:
                     # plan building is host-side math — c128 is fine here
                     cf = K.complex_from_pair(cf)
                 nz = (cf != 0) & (a_c != SENTINEL_STATE)[:, None]
                 yield s, e, n_c, betas, cf, nz
+                pending = nxt
 
         # -- pass 1: row-nnz counts, per-peer unique remote targets, local
         #    sector check — own shards only, chunk-streamed ----------------
@@ -796,6 +842,16 @@ class DistributedEngine:
 
     # -- plan checkpoint (ell/compact) ----------------------------------
 
+    def _resolve_structure_cache(self, path: Optional[str]) -> Optional[str]:
+        """Explicit caller path wins; otherwise the content-addressed
+        artifact-cache default (None when the layer is off).  The
+        fingerprint is identical on every rank, so the default path is
+        consistent across a multi-controller run."""
+        if path is not None:
+            return path
+        from ..utils.artifacts import default_structure_cache
+        return default_structure_cache(self._structure_fingerprint())
+
     def _structure_sidecar(self, path: str) -> str:
         """Distinct from LocalEngine's sidecar (and per mesh size) so local
         and distributed checkpoints for the same basis don't thrash."""
@@ -962,13 +1018,15 @@ class DistributedEngine:
                 return np.asarray(piece.data)[0]
         return None
 
-    def _save_structure(self, path: Optional[str]) -> None:
+    def _save_structure(self, path: Optional[str], soft: bool = False) -> None:
         """Write the per-shard (v3) structure sidecar.
 
         Each rank writes its OWN file (``.r<rank>`` suffix in
         multi-controller runs) holding only its addressable shards'
         datasets — no rank ever materializes a global table, so the cache
-        works for multi-process and shard-native engines alike.
+        works for multi-process and shard-native engines alike.  ``soft``
+        marks DEFAULT-path (artifact cache) saves: size-capped by
+        ``artifact_max_gb`` and degrading to a debug log on I/O errors.
         """
         if not path:
             return
@@ -1001,8 +1059,15 @@ class DistributedEngine:
         sidecar = self._structure_sidecar(path)
         if jax.process_count() > 1:
             sidecar = f"{sidecar}.r{jax.process_index()}"
-        save_engine_structure(sidecar, self._structure_fingerprint(),
-                              self.mode, payload)
+        if soft:
+            from ..utils.artifacts import soft_save_structure
+            if not soft_save_structure(sidecar,
+                                       self._structure_fingerprint(),
+                                       self.mode, payload):
+                return
+        else:
+            save_engine_structure(sidecar, self._structure_fingerprint(),
+                                  self.mode, payload)
         log_debug(f"distributed plan checkpointed to {sidecar}")
 
     def _make_compact_matvec(self):
@@ -1066,7 +1131,7 @@ class DistributedEngine:
             # scan branch of `terms` hits this, i.e. the LARGE-T0 regime
             # small-config tests never reach)
             def zvar(a):
-                return jax.lax.pcast(a, SHARD_AXIS, to="varying")
+                return pcast_varying(a, SHARD_AXIS)
             acc = terms(zvar(jnp.zeros(x.shape, jnp.float64)), tags, T0)
             d = diag.reshape(diag.shape + (1,) * (x.ndim - 1))
             sc = (W * inv_n).reshape(inv_n.shape + (1,) * (x.ndim - 1))
@@ -1086,7 +1151,7 @@ class DistributedEngine:
             qin, tags, diag, inv_n, n_parts, norms_all, tail = operands
             tail_specs = tuple(_pspec(a.ndim) for a in tail) if has_tail \
                 else P()
-            f = jax.shard_map(
+            f = shard_map_compat(
                 shard_body, mesh=mesh,
                 in_specs=(_pspec(x.ndim), _pspec(qin.ndim),
                           _pspec(tags.ndim), _pspec(diag.ndim),
@@ -1148,8 +1213,8 @@ class DistributedEngine:
             if has_tail:
                 rows, idx_t, cf_t = (a[0] for a in tail)
                 zshape = rows.shape + x.shape[1:]
-                acc = terms(jax.lax.pcast(jnp.zeros(zshape, dtype),
-                                          SHARD_AXIS, to="varying"),
+                acc = terms(pcast_varying(jnp.zeros(zshape, dtype),
+                                           SHARD_AXIS),
                             idx_t, cf_t, idx_t.shape[0])
                 y = y.at[rows].add(acc, mode="drop")
             return y[None]
@@ -1160,7 +1225,7 @@ class DistributedEngine:
             qin, gidx, coeff, diag, tail = operands
             tail_specs = tuple(_pspec(a.ndim) for a in tail) if has_tail \
                 else P()
-            f = jax.shard_map(
+            f = shard_map_compat(
                 shard_body, mesh=mesh,
                 in_specs=(_pspec(x.ndim), _pspec(qin.ndim), _pspec(gidx.ndim),
                           _pspec(coeff.ndim), _pspec(diag.ndim), tail_specs),
@@ -1325,10 +1390,10 @@ class DistributedEngine:
                         num_segments=M)
                     return (y, overflow, invalid), None
 
-                init = jax.lax.pcast(
+                init = pcast_varying(
                     (jnp.zeros((M,) + tail, dtype), jnp.zeros((), jnp.int64),
                      jnp.zeros((), jnp.int64)),
-                    SHARD_AXIS, to="varying",
+                    SHARD_AXIS,
                 )
                 (y, overflow, invalid), _ = jax.lax.scan(
                     chunk, init,
@@ -1342,7 +1407,7 @@ class DistributedEngine:
 
             def apply_fn(x, operands):
                 alphas, norms, diag, tables, lk_pair, lk_dir = operands
-                f = jax.shard_map(
+                f = shard_map_compat(
                     shard_body, mesh=mesh,
                     in_specs=(_pspec(x.ndim), _pspec(2), _pspec(2), P(),
                               _pspec(3), _pspec(2)),

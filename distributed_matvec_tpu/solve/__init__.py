@@ -1,4 +1,4 @@
 """Eigensolvers (L6) — the PRIMME/Diagonalize analog (SURVEY.md §7.7)."""
 
-from .lanczos import LanczosResult, lanczos  # noqa: F401
+from .lanczos import LanczosResult, lanczos, lanczos_block  # noqa: F401
 from .lobpcg import lobpcg  # noqa: F401

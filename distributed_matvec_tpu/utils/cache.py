@@ -3,9 +3,11 @@
 First-compile of the engine programs costs tens of seconds per process over
 a tunneled TPU (measured 10.6 s → 0.7 s for a toy program once cached, and
 30-70 s for the structure-build programs).  JAX's persistent cache removes
-that for every process after the first; entry points (bench, CLI, graft
-entry) opt in via :func:`enable_compilation_cache`.  Library code does NOT
-enable it implicitly — the cache directory choice belongs to the harness.
+that for every process after the first.  Entry points (bench, CLI, graft
+entry) opt in via :func:`enable_compilation_cache` with their own directory
+choice; the engines themselves route through
+:func:`~.artifacts.ensure_compilation_cache`, which defers to any explicit
+harness choice and is gated by the ``artifact_cache`` knob.
 """
 
 from __future__ import annotations
@@ -18,17 +20,30 @@ _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache",
                         "distributed_matvec_tpu", "xla")
 
 
+def _default_dir() -> str:
+    """Default cache dir: under the artifact root when the artifact layer
+    is on (one warmable tree), the legacy ``…/xla`` path otherwise."""
+    try:
+        from .artifacts import artifact_root, artifacts_enabled
+
+        if artifacts_enabled():
+            return os.path.join(artifact_root(), "xla")
+    except Exception:
+        pass
+    return _DEFAULT
+
+
 def enable_compilation_cache(directory: str | None = None) -> str:
     """Point JAX at a persistent compilation cache directory and return it.
 
     Respects an existing ``JAX_COMPILATION_CACHE_DIR`` environment setting;
-    otherwise uses ``directory`` or ``~/.cache/distributed_matvec_tpu/xla``.
-    Safe to call multiple times.
+    otherwise uses ``directory``, the artifact root's ``xla/`` subtree, or
+    ``~/.cache/distributed_matvec_tpu/xla``.  Safe to call multiple times.
     """
     import jax
 
     directory = (os.environ.get("JAX_COMPILATION_CACHE_DIR") or directory
-                 or _DEFAULT)
+                 or _default_dir())
     os.makedirs(directory, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", directory)
     # cache everything that took meaningful compile time — unless the user

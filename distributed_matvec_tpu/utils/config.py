@@ -63,6 +63,18 @@ class RuntimeConfig:
     #    refuse native-c128 sectors on the TPU backend unless this is set —
     #    with complex_pair="auto" they run in pair form instead)
 
+    # -- artifact cache (utils/artifacts.py) --------------------------------
+    artifact_cache: str = "on"             # default-on content-addressed
+    #   cache of basis representatives, engine structure sidecars, and the
+    #   XLA compilation cache ("off" disables the whole layer; explicit
+    #   structure_cache= paths are unaffected either way)
+    artifact_dir: str = ""                 # cache root override (also
+    #   DMT_ARTIFACT_DIR); default ~/.cache/distributed_matvec_tpu/artifacts
+    artifact_max_gb: float = 8.0           # per-sidecar size cap for
+    #   DEFAULT-path structure saves: tables beyond this are rebuilt per
+    #   process instead of silently filling the cache disk (explicit
+    #   structure_cache= paths are never capped)
+
 
 
 _ENV_PREFIX = "DMT_"
@@ -107,6 +119,41 @@ def update_config(**kwargs) -> RuntimeConfig:
     return cfg
 
 
+_xla_flag_support: dict = {}
+
+
+def xla_flag_supported(flag: str) -> bool:
+    """Whether this jaxlib's XLA knows ``flag`` (an ``XLA_FLAGS`` name).
+
+    XLA *hard-aborts the whole process* on unknown names in ``XLA_FLAGS``
+    ("Unknown flags in XLA_FLAGS", parse_flags_from_env.cc) at first
+    backend creation — long after the append, in whatever innocent code
+    happens to build the first client (observed: pytest collection dying
+    inside ``jax.devices()``).  There is no query API, but a supported
+    flag's name string is necessarily embedded in the extension binary
+    that parses it, so a byte scan of ``jaxlib.xla_extension`` decides
+    support without risking the fatal.  False when the binary cannot be
+    located — the safe direction (worst case we skip an optional flag).
+    """
+    if flag in _xla_flag_support:
+        return _xla_flag_support[flag]
+    found = False
+    try:
+        import mmap
+
+        import jaxlib.xla_extension as _xe
+
+        path = getattr(_xe, "__file__", None)
+        if path and os.path.isfile(path) and os.path.getsize(path):
+            with open(path, "rb") as f, \
+                    mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as m:
+                found = m.find(flag.encode()) != -1
+    except Exception:
+        found = False
+    _xla_flag_support[flag] = found
+    return found
+
+
 def ensure_cpu_collective_timeout(seconds: int = 1200) -> bool:
     """Raise XLA's CPU collective rendezvous termination timeout.
 
@@ -121,9 +168,11 @@ def ensure_cpu_collective_timeout(seconds: int = 1200) -> bool:
     backends: it only governs the CPU collective rendezvous).
 
     Returns True when the flag is (now) present in ``XLA_FLAGS``; False
-    when a backend already initialised without it, in which case the
-    caller must re-exec to benefit (``DMT_`` env knobs can't help — this
-    is an XLA runtime flag, not an engine parameter).
+    when a backend already initialised without it (the caller must re-exec
+    to benefit — this is an XLA runtime flag, not an engine parameter) or
+    when this jaxlib's XLA does not know the flag at all (appending it
+    would turn the first backend init into a process abort; such builds
+    predate the CPU rendezvous kill-switch, so there is nothing to raise).
     """
     flag = "xla_cpu_collective_call_terminate_timeout_seconds"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -135,5 +184,7 @@ def ensure_cpu_collective_timeout(seconds: int = 1200) -> bool:
             return False
     except Exception:                   # private API moved: assume not yet
         pass
+    if not xla_flag_supported(flag):
+        return False
     os.environ["XLA_FLAGS"] = (flags + f" --{flag}={seconds}").strip()
     return True

@@ -77,6 +77,26 @@ class TreeTimer:
         self.root.count = 1
         return self.root.total
 
+    def to_dict(self) -> dict:
+        """Nested ``{name: {total, count, children}}`` snapshot of the tree
+        — machine-readable counterpart of :meth:`report` (bench.py records
+        the engine-init build/compile/transfer split from it)."""
+        def walk(node: _Node) -> dict:
+            return {"total": node.total, "count": node.count,
+                    "children": {k: walk(c)
+                                 for k, c in node.children.items()}}
+        return walk(self.root)
+
+    def scope_total(self, *path: str) -> float:
+        """Sum of one scope's total seconds at ``path`` under the root
+        (0.0 when the scope never ran)."""
+        node = self.root
+        for name in path:
+            node = node.children.get(name)
+            if node is None:
+                return 0.0
+        return node.total
+
     def report(self, force: bool = False) -> Optional[str]:
         if not (force or get_config().display_timings):
             return None

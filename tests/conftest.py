@@ -14,6 +14,11 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_ENABLE_X64"] = "true"
+# Hermetic tests: the default-on artifact cache (utils/artifacts.py) would
+# otherwise let engines restore structures written by earlier sessions (or
+# earlier tests) from ~/.cache, flipping `structure_restored` expectations.
+# Tests that exercise the layer re-enable it against a tmp_path root.
+os.environ["DMT_ARTIFACT_CACHE"] = "off"
 
 import jax  # noqa: E402
 

@@ -18,9 +18,16 @@ from test_operator import build_heisenberg
 
 ATOL, RTOL = 1e-13, 1e-12
 
-needs_8 = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs 8 virtual devices"
-)
+def _ndev() -> int:
+    """Device count, queried lazily: a module-import-time ``jax.devices()``
+    initializes the backend during pytest collection, where an XLA-level
+    fatal (bad XLA_FLAGS, dead plugin) aborts the whole run instead of
+    failing one module."""
+    return len(jax.devices())
+
+
+# string condition → evaluated lazily at test setup, not at import
+needs_8 = pytest.mark.skipif("_ndev() < 8", reason="needs 8 virtual devices")
 
 
 # -- layout shuffles ---------------------------------------------------------
