@@ -93,16 +93,25 @@ def main(argv=None):
                     help="evaluate ⟨ψ|O|ψ⟩ for YAML observables")
     ap.add_argument("--timings", action="store_true",
                     help="print phase timings (kDisplayTimings)")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="telemetry run directory (sets obs_dir / "
+                         "DMT_OBS_DIR): engine-init splits, solver "
+                         "convergence traces, and phase timings stream to "
+                         "DIR/events.p<rank>.jsonl for tools/obs_report.py")
     args = ap.parse_args(argv)
     if args.mode is None:
         args.mode = "fused" if args.shards else "ell"
 
+    from distributed_matvec_tpu import obs
     from distributed_matvec_tpu.io import (
         make_or_restore_representatives, save_eigen)
     from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
     from distributed_matvec_tpu.solve import lanczos, lobpcg
     from distributed_matvec_tpu.utils.config import update_config
     from distributed_matvec_tpu.utils.timers import TreeTimer
+
+    if args.obs_dir:
+        update_config(obs_dir=args.obs_dir)
 
     if args.coordinator or args.num_processes:
         from distributed_matvec_tpu.parallel.mesh import init_distributed
@@ -117,6 +126,9 @@ def main(argv=None):
     rank0 = jax.process_index() == 0
     out = args.output or os.path.splitext(args.input)[0] + ".h5"
     timer = TreeTimer("diagonalize")
+    obs.emit("run_start", app="diagonalize", input=args.input, output=out,
+             k=args.num_evals, devices=args.devices,
+             mode=args.mode, block=bool(args.block))
 
     with timer.scope("load_config"):
         cfg = load_config_from_yaml(args.input, hamiltonian=True,
@@ -202,6 +214,10 @@ def main(argv=None):
         dt = time.perf_counter() - t0
     print(f"solver: {niter} iterations in {dt:.2f}s "
           f"({niter / max(dt, 1e-9):.2f} iters/s)")
+    obs.emit("diagonalize_result",
+             eigenvalues=[float(w) for w in np.atleast_1d(evals)],
+             residuals=[float(r) for r in np.atleast_1d(residuals)],
+             iters=int(niter), solve_s=round(dt, 3))
 
     evec_rows = None
     evecs_hashed = None
@@ -352,6 +368,12 @@ def main(argv=None):
             for name, val in save_observables(out, values).items():
                 print(f"  <{name}> = {val:.12f}")
 
+    # phase timings + registry totals into the same stream the engines and
+    # solvers wrote, then flush — the run dir is self-contained for
+    # `obs_report summarize` the moment the process exits
+    timer.emit(app="diagonalize")
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
     timer.report()
     return 0
 

@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from distributed_matvec_tpu import obs
 from distributed_matvec_tpu.utils.cache import enable_compilation_cache
 
 enable_compilation_cache()
@@ -43,6 +44,11 @@ def _build_op(basis_args, n_sites, edges=None):
     return op
 
 
+# set from --profile-dir; _bench_config reads it so the per-config call
+# sites don't all thread one more parameter through
+_PROFILE_DIR = None
+
+
 def _default_cache_dir():
     """Fallback checkpoint dir for runs with the artifact layer OFF; when
     the layer is on, bench uses the engines' own content-addressed default
@@ -61,6 +67,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     from distributed_matvec_tpu.utils.artifacts import (artifacts_enabled,
                                                         make_or_restore_basis)
 
+    profile_dir = _PROFILE_DIR
     n_sites = basis_args["number_spins"]
     # representative + engine-structure checkpoints: repeat bench runs (and
     # a rerun inside a short accelerator window) spend their time measuring,
@@ -83,6 +90,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
                       sorted(map(tuple, edges)) if edges is not None
                       else None)).encode()).hexdigest()[:12]
             ck = os.path.join(cache_dir, f"{name}-{ident}.h5")
+    obs.emit("bench_config_start", config=name)
     _progress(f"{name}: building basis")
     t0 = time.perf_counter()
     op = _build_op(basis_args, n_sites, edges)
@@ -105,6 +113,13 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     _progress(f"{name}: engine ready in {init_s:.1f}s, timing matvec")
     xj = jax.numpy.asarray(x)
     y = jax.block_until_ready(eng._matvec(xj)[0])  # compile
+    if profile_dir:
+        # exactly ONE profiled apply per config, into its own subdirectory
+        # (maybe_profile's explicit override — no env-var gymnastics and no
+        # trace pollution from the timing loops below)
+        from distributed_matvec_tpu.utils.profiling import maybe_profile
+        with maybe_profile(profile_dir=os.path.join(profile_dir, name)):
+            jax.block_until_ready(eng._matvec(xj)[0])
     t0 = time.perf_counter()
     for _ in range(repeats):
         y = eng._matvec(xj)[0]
@@ -216,6 +231,13 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
             out["lanczos_rate_includes_compile"] = True
         out["lanczos_total_s"] = round(dt, 2)
         out["lanczos_e0"] = float(res.eigenvalues[0])
+    # recording rides the telemetry layer: the per-config record is ONE
+    # bench_result event next to the engine_init / lanczos_trace events the
+    # construction and solve above already emitted, and the timing tree
+    # lands in the same stream via the TreeTimer bridge —
+    # `obs_report summarize` reconstructs the whole run from the JSONL alone
+    eng.timer.emit(config=name)
+    obs.emit("bench_result", **out)
     return out
 
 
@@ -267,7 +289,17 @@ def main():
                     help="run the full CPU-feasible config matrix on the "
                          "CPU backend (what a failed device probe degrades "
                          "to automatically)")
+    ap.add_argument("--detail-out", default=None, metavar="PATH",
+                    help="where to write the per-config detail JSON "
+                         "(default: BENCH_DETAIL.json next to this script; "
+                         "CI perf-gate runs use a scratch path so the "
+                         "recorded artifact stays the baseline)")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="profile exactly one apply per config into "
+                         "DIR/<config> via jax.profiler")
     args = ap.parse_args()
+    global _PROFILE_DIR
+    _PROFILE_DIR = args.profile_dir
 
     # Full runs target the accelerator, which can be wedged — probe first and
     # degrade to a marked CPU fallback run rather than hanging the driver.
@@ -275,9 +307,15 @@ def main():
             and not _probe_device()):
         _progress("falling back to a CPU run of the full small-config matrix")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        os.execve(sys.executable,
-                  [sys.executable, os.path.abspath(__file__),
-                   "--cpu-fallback"], env)
+        # re-exec keeps the output-path/profiling flags: the fallback run
+        # must not clobber the recorded BENCH_DETAIL.json baseline when the
+        # caller pointed --detail-out elsewhere
+        argv = [sys.executable, os.path.abspath(__file__), "--cpu-fallback"]
+        if args.detail_out:
+            argv += ["--detail-out", args.detail_out]
+        if args.profile_dir:
+            argv += ["--profile-dir", args.profile_dir]
+        os.execve(sys.executable, argv, env)
 
     if args.smoke or args.cpu_fallback:
         # The env var alone is not enough on this image: the accelerator
@@ -287,11 +325,20 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    # first telemetry event only AFTER the platform pin and liveness probe:
+    # emit() stamps the process index, which initializes the JAX backend —
+    # doing that earlier would re-open the dead-accelerator hang the probe
+    # and the explicit CPU pin exist to avoid
+    obs.emit("bench_start", argv=sys.argv[1:], obs_dir=obs.run_dir() or "")
+
     detail = {}
     if args.smoke:
+        # 50 timing repeats (each ~1 ms on CPU): a 5-repeat mean scattered
+        # ~5× run-to-run on a shared host, far too noisy for the obs-check
+        # perf gate to compare against
         main_cfg = _bench_config(
             "heisenberg_chain_16", dict(number_spins=16, hamming_weight=8),
-            repeats=5, host_repeats=1, solver_iters=20)
+            repeats=50, host_repeats=1, solver_iters=20)
     elif args.cpu_fallback:
         # Dead-chip round: run every config that is CPU-feasible (same
         # config keys as the recorded full run, minus chain_32_symm whose
@@ -375,14 +422,15 @@ def main():
         "unit": "ms",
         "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
     }
-    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_DETAIL.json")
+    detail_path = args.detail_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     try:
         with open(detail_path + ".tmp", "w") as f:
             json.dump({"main": main_cfg, **detail}, f,
                       indent=1, sort_keys=True)
         os.replace(detail_path + ".tmp", detail_path)  # atomic: no torn/
-        line["detail_file"] = "BENCH_DETAIL.json"      # stale sidecar
+        line["detail_file"] = (args.detail_out         # stale sidecar
+                               or "BENCH_DETAIL.json")
     except OSError as e:
         # an unwritable checkout must not cost the metric line itself;
         # degrade to inline detail (the pre-r5 behavior)
@@ -393,6 +441,11 @@ def main():
         line["note"] = ("accelerator unreachable at bench time; CPU numbers "
                         "in BENCH_DETAIL.json (chain_32_symm omitted — "
                         "CPU-infeasible); recorded TPU results in README")
+    # registry totals (cache hit/miss, AOT reuse, transfer bytes, retraces)
+    # as the run's closing event, then flush so `obs_report summarize`
+    # reads a complete stream the moment this process exits
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
     print(json.dumps(line))
     return 0
 

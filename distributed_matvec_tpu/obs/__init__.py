@@ -1,0 +1,59 @@
+"""obs — process-wide telemetry: metrics registry, structured event log,
+profiler annotations.
+
+The reference answers "why was this run slow?" with tree timers and comm
+diagnostics behind ``--kDisplayTimings``/``--kVerboseComm``; after the
+warm-start caches of DESIGN.md §16 the same question here spans artifact
+hits, AOT executable reuse, host↔device transfer volume, and solver
+convergence — none of it visible from a wall clock.  This package is the
+observability spine those signals report through (the per-phase accounting
+arXiv:2112.09017 credits for its scaling wins, plus the compile/retrace
+visibility GSPMD (arXiv:2105.04663) treats as a first-class signal):
+
+* :mod:`~.metrics` — counters / gauges / fixed-bucket histograms with
+  labeled series (``matvec_apply_ms{engine=local}``,
+  ``artifact_cache{event=hit}``, ``bytes_h2d``, ``retrace_count``);
+  :func:`snapshot` turns the registry into plain data.
+* :mod:`~.events` — append-only JSONL per process
+  (``<run_dir>/events.p<proc>.jsonl``, monotonic ``seq``, soft-fail
+  writes), an in-memory ring buffer, and :func:`annotate` spans that line
+  the JSONL timeline up with ``jax.profiler`` Perfetto traces.
+* ``tools/obs_report.py`` — the reader: ``summarize`` one run, ``diff``
+  two runs as a CI perf gate, ``tail`` a live one.
+
+Config: ``DMT_OBS_DIR`` (or ``obs_dir``) points the sink at a run
+directory; unset ⇒ in-memory only; ``DMT_OBS=off`` disables the layer
+entirely, at which point every instrument is the shared no-op
+:data:`~.metrics.NULL` and the instrumented hot paths add **zero
+device-side work** (no syncs, no fetches — guard-tested).
+"""
+
+from .events import (annotate, emit, event_path, events, flush, obs_enabled,
+                     reset, run_dir)
+from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
+                      reset_metrics, series_name, snapshot)
+
+__all__ = [
+    "annotate",
+    "emit",
+    "event_path",
+    "events",
+    "flush",
+    "obs_enabled",
+    "reset",
+    "run_dir",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "series_name",
+    "reset_metrics",
+    "NULL",
+    "DEFAULT_BUCKETS",
+]
+
+
+def reset_all() -> None:
+    """Reset events AND metrics (test isolation helper)."""
+    reset()
+    reset_metrics()

@@ -1,0 +1,195 @@
+"""Structured event sink: append-only JSONL per process + profiler bridge.
+
+One pillar of the telemetry subsystem (see ``obs/__init__``).  Every event is
+a flat JSON object with a fixed envelope::
+
+    {"seq": 17, "ts": 1754092800.123456, "proc": 0, "kind": "engine_init",
+     ...payload fields...}
+
+``seq`` is a per-process monotonic sequence number (readers order a run by
+``(proc, seq)`` — wall clocks across hosts are not trusted), ``proc`` the JAX
+process index.  With ``DMT_OBS_DIR`` (or ``config.obs_dir``) set, each
+process appends to its OWN file ``<dir>/events.p<proc>.jsonl`` — multi-host
+safe by construction, no cross-process file locking — and every event is
+also kept in a bounded in-memory ring buffer (:func:`events`) so a live
+process can inspect its own stream.  With no directory configured the layer
+still runs in-memory only (the default), and with ``DMT_OBS=off`` it is
+fully disabled (:func:`emit` returns ``None`` without building an event).
+
+Sink writes fail SOFT, mirroring the artifact layer's loud/quiet split
+(``utils/artifacts.py``): a read-only checkout or full disk logs one
+``log_warn`` and degrades to in-memory — telemetry must never turn a
+computation into an I/O error.
+
+:func:`annotate` bridges the host-side event timeline into device-side
+``jax.profiler`` traces: it returns a ``TraceAnnotation`` context so the
+phases instrumented here (engine init, chunk build, apply) show up as named
+spans in Perfetto/TensorBoard, lining up with the JSONL timestamps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import List, Optional
+
+from ..utils.config import get_config
+from ..utils.logging import _process_index, log_warn
+
+__all__ = [
+    "obs_enabled",
+    "run_dir",
+    "event_path",
+    "emit",
+    "events",
+    "annotate",
+    "flush",
+    "reset",
+]
+
+_BUFFER_CAP = 1 << 16
+
+_lock = threading.Lock()
+_buffer: deque = deque(maxlen=_BUFFER_CAP)
+_seq = 0
+_sink = None                 # open file object, or None
+_sink_path: Optional[str] = None
+_sink_failed = False
+
+
+def obs_enabled() -> bool:
+    """Whether the telemetry layer is active (default on).
+
+    The env var is consulted directly (not just through the config
+    snapshot) so a harness can flip it for a subprocess without racing the
+    config cache — same contract as ``artifacts_enabled``."""
+    env = os.environ.get("DMT_OBS")
+    knob = env if env is not None else get_config().obs
+    return str(knob).strip().lower() not in ("off", "0", "false", "no")
+
+
+def run_dir() -> Optional[str]:
+    """The event-sink run directory, or None for in-memory-only operation
+    (``DMT_OBS_DIR`` env var > ``obs_dir`` config field)."""
+    if not obs_enabled():
+        return None
+    return os.environ.get("DMT_OBS_DIR") or get_config().obs_dir or None
+
+
+def event_path() -> Optional[str]:
+    """This process's JSONL file path, or None when no sink is configured."""
+    d = run_dir()
+    if not d:
+        return None
+    return os.path.join(d, f"events.p{_process_index()}.jsonl")
+
+
+def _json_default(o):
+    """Make numpy scalars/arrays (the payloads solvers and engines carry)
+    JSON-serializable; anything else degrades to its repr — an exotic field
+    must not cost the event line."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:  # pragma: no cover - numpy is a hard dep
+        pass
+    return repr(o)
+
+
+def _write(ev: dict) -> None:
+    global _sink, _sink_path, _sink_failed
+    if _sink_failed:
+        return
+    path = event_path()
+    if path is None:
+        return
+    try:
+        if _sink is None or _sink_path != path:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            if _sink is not None:
+                _sink.close()
+            # line-buffered append so `obs_report tail --follow` sees events
+            # as they happen, and a crash loses at most the current line
+            _sink = open(path, "a", buffering=1)
+            _sink_path = path
+        _sink.write(json.dumps(ev, default=_json_default) + "\n")
+    except OSError as e:
+        _sink_failed = True  # degrade to in-memory; warn ONCE, not per event
+        log_warn(f"event sink disabled ({path}): {e!r}")
+
+
+def emit(kind: str, **fields) -> Optional[dict]:
+    """Record one event; returns the full event dict, or None when the
+    layer is disabled.  Payload ``fields`` must not use the envelope keys
+    (``seq``/``ts``/``proc``/``kind`` — they would be overwritten)."""
+    global _seq
+    if not obs_enabled():
+        return None
+    with _lock:
+        seq = _seq
+        _seq += 1
+        ev = {"seq": seq, "ts": round(time.time(), 6),
+              "proc": _process_index(), "kind": str(kind)}
+        ev.update(fields)
+        _buffer.append(ev)
+        _write(ev)
+    return ev
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    """Snapshot of this process's in-memory event buffer (optionally
+    filtered by ``kind``) — newest last."""
+    with _lock:
+        evs = list(_buffer)
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+def annotate(name: str):
+    """Context manager marking a named span in the active ``jax.profiler``
+    trace (no-op when the layer is off or jax is unavailable).  Host-side
+    only — a ``TraceAnnotation`` never launches device work."""
+    if not obs_enabled():
+        return nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
+
+
+def flush() -> None:
+    """Flush the JSONL sink (harness exit points; in-memory mode no-op)."""
+    with _lock:
+        if _sink is not None:
+            try:
+                _sink.flush()
+            except OSError:
+                pass
+
+
+def reset() -> None:
+    """Close the sink and clear buffer + sequence counter (tests; also the
+    way to re-point an already-running process at a new ``obs_dir``)."""
+    global _seq, _sink, _sink_path, _sink_failed
+    with _lock:
+        if _sink is not None:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        _sink = None
+        _sink_path = None
+        _sink_failed = False
+        _seq = 0
+        _buffer.clear()

@@ -53,6 +53,7 @@ sorted (*block*) order.  Batches ``[D, M, k]`` are supported end-to-end.
 from __future__ import annotations
 
 import math
+import time
 from typing import Optional
 
 import jax
@@ -61,6 +62,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.operator import Operator
+from ..obs import annotate, counter, histogram
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
@@ -70,8 +72,8 @@ from ..utils.timers import TreeTimer
 from .engine import (SENTINEL_STATE, apply_diag_jit,
                      attach_traced_counter_check,
                      check_complex_backend, choose_ell_split,
-                     gather_coefficients_jit, precompile,
-                     raise_deferred_failure,
+                     emit_engine_init, gather_coefficients_jit, precompile,
+                     raise_deferred_failure, record_structure_cache,
                      compact_magnitude, unroll_terms_ok, use_pair_complex)
 from .mesh import (SHARD_AXIS, make_mesh, pcast_varying,
                    shard_map_compat, shard_spec)
@@ -111,6 +113,7 @@ class DistributedEngine:
                  structure_cache: Optional[str] = None,
                  layout: Optional[HashedLayout] = None,
                  shards_path: Optional[str] = None):
+        _t_init = time.perf_counter()
         basis = operator.basis
         #: True when the representatives came from the artifact-cache
         #: checkpoint rather than a fresh enumeration (always False for
@@ -199,8 +202,10 @@ class DistributedEngine:
         self.counts = counts
         from ..utils.artifacts import ensure_compilation_cache
         ensure_compilation_cache()
-        with self.timer.scope("transfer"):
+        with self.timer.scope("transfer"), annotate("engine_init/transfer"):
             self.tables = K.device_tables(operator, pair=self.pair)
+        counter("bytes_h2d", path="engine_tables").inc(sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(self.tables)))
         self.num_terms = int(self.tables.off.x.shape[0])
         self._sh1 = shard_spec(self.mesh, 2)
         self._sh2 = shard_spec(self.mesh, 3)
@@ -280,8 +285,11 @@ class DistributedEngine:
         if mode == "ell":
             self.structure_restored = agree_restored(
                 self._try_load_structure(structure_cache))
+            record_structure_cache(self.structure_restored,
+                                   structure_cache is not None)
             if not self.structure_restored:
-                with self.timer.scope("build_plan"):
+                with self.timer.scope("build_plan"), \
+                        annotate("engine_init/build_plan"):
                     self._plan_stream(row_provider, compact=False)
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_ell_matvec()
@@ -293,6 +301,8 @@ class DistributedEngine:
                     "for complex-character momentum sectors)")
             self.structure_restored = agree_restored(
                 self._try_load_structure(structure_cache))
+            record_structure_cache(self.structure_restored,
+                                   structure_cache is not None)
             if not self.structure_restored:
                 # W sample strided across this process's shards (the hash
                 # partition makes any shard an unbiased basis sample), so
@@ -322,7 +332,8 @@ class DistributedEngine:
                         f"compact mode needs a single off-diagonal "
                         f"magnitude, found {vals[:5]}; use mode='ell'")
                 self._c_W = float(vals[0]) if vals.size else 0.0
-                with self.timer.scope("build_plan"):
+                with self.timer.scope("build_plan"), \
+                        annotate("engine_init/build_plan"):
                     self._plan_stream(row_provider, compact=True)
                 self._save_structure(structure_cache, soft=soft_save)
                 self._c_n_all_shards = None   # only needed by the save above
@@ -368,6 +379,8 @@ class DistributedEngine:
             self._lk_dir = self._assemble_sharded(dir_rows)
             self._capacity = self._fused_capacity()
             self._matvec = self._make_fused_matvec()
+        emit_engine_init(self, "distributed",
+                         init_s=time.perf_counter() - _t_init)
         self.timer.report()  # tree print, gated by display_timings
 
     @classmethod
@@ -428,8 +441,9 @@ class DistributedEngine:
         if not self._shard_addressable(d):
             return None
         devs = list(self.mesh.devices.flat)
-        return jax.device_put(
-            np.ascontiguousarray(np.asarray(piece))[None], devs[d])
+        piece = np.ascontiguousarray(np.asarray(piece))
+        counter("bytes_h2d", path="shard_put").inc(piece.nbytes)
+        return jax.device_put(piece[None], devs[d])
 
     def _assemble_sharded(self, shards):
         """[D, ...] device array from per-shard pieces via
@@ -526,6 +540,8 @@ class DistributedEngine:
                         [a_c, np.full(Bc - (e - s), SENTINEL_STATE,
                                       np.uint64)])
                     n_c = np.concatenate([n_c, np.ones(Bc - (e - s))])
+                counter("bytes_h2d", path="plan_chunk_stream").inc(
+                    a_c.nbytes + n_c.nbytes)
                 with self.timer.scope("transfer"):
                     a_dev, n_dev = jnp.asarray(a_c), jnp.asarray(n_c)
                 return s, e, a_c, n_c, gather_chunk(self.tables, a_dev,
@@ -535,8 +551,17 @@ class DistributedEngine:
             for ci in range(nchunks):
                 nxt = launch(ci + 1) if ci + 1 < nchunks else None
                 s, e, a_c, n_c, (betas_d, cf_d) = pending
+                # the fetch below is where the double-buffering either paid
+                # off (device finished while the host routed the previous
+                # chunk → ~0 stall) or didn't — record the wait, it is the
+                # stream's whole performance story
+                _t_fetch = time.perf_counter()
                 with self.timer.scope("transfer"):
                     betas, cf = np.asarray(betas_d), np.asarray(cf_d)
+                histogram("double_buffer_stall_ms").observe(
+                    (time.perf_counter() - _t_fetch) * 1e3)
+                counter("bytes_d2h", path="plan_chunk_stream").inc(
+                    betas.nbytes + cf.nbytes)
                 if self.pair:
                     # plan building is host-side math — c128 is fine here
                     cf = K.complex_from_pair(cf)
@@ -1563,7 +1588,10 @@ class DistributedEngine:
         invalid-state counters — the loud-failure analogs of the reference's
         blocking buffers and halt (DistributedMatrixVector.chpl:113-118).
         """
-        with self.timer.scope("matvec"):
+        # telemetry measures eager *dispatch* wall time only (async queue —
+        # NO block_until_ready here: recording must never add a sync)
+        _t0 = time.perf_counter()
+        with self.timer.scope("matvec"), annotate("matvec/distributed"):
             xh = jnp.asarray(xh)
             if self.pair and (xh.ndim not in (3, 4) or xh.shape[-1] != 2):
                 raise ValueError(
@@ -1596,6 +1624,8 @@ class DistributedEngine:
             if check or (check is None and key not in self._checked):
                 self._validate_counters(int(overflow), int(invalid), key)
                 self._checked.add(key)
+        histogram("matvec_apply_ms", engine="distributed").observe(
+            (time.perf_counter() - _t0) * 1e3)
         return y
 
     def _validate_counters(self, overflow: int, invalid: int, key) -> None:

@@ -33,6 +33,7 @@ and checked on first application.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -41,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.operator import Operator
+from ..obs import annotate, counter, emit, histogram
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled, split_parts
@@ -77,6 +79,12 @@ def pad_to_multiple(n: int, b: int) -> int:
 
 _PROGRAM_CACHE: Dict[tuple, Any] = {}
 
+# (name, statics) → shape keys already compiled: a SECOND shape key for the
+# same program is a genuine retrace (shape instability), which is what the
+# `retrace_count` metric reports — first-time compiles of distinct programs
+# are the healthy cold path and only count as `aot_executable_cache` compiles.
+_PROGRAM_SHAPES: Dict[tuple, set] = {}
+
 # shared shape-polymorphic programs under ONE jit wrapper each: every engine
 # reuses a single trace cache instead of re-tracing per construction
 apply_diag_jit = jax.jit(K.apply_diag)
@@ -93,12 +101,20 @@ def precompile(name: str, statics: tuple, jit_fn, args, timer) -> Any:
     """Compile ``jit_fn`` for ``args``' shapes once per (name, statics,
     shapes) and return the executable; compile time lands in ``timer``'s
     ``compile`` scope (zero on a process-cache hit)."""
-    key = (name, statics, _shape_key(args))
+    shapes = _shape_key(args)
+    key = (name, statics, shapes)
     ex = _PROGRAM_CACHE.get(key)
     if ex is None:
-        with timer.scope("compile"):
+        counter("aot_executable_cache", event="compile").inc()
+        seen = _PROGRAM_SHAPES.setdefault((name, statics), set())
+        if seen and shapes not in seen:
+            counter("retrace_count").inc()
+        seen.add(shapes)
+        with timer.scope("compile"), annotate(f"compile/{name}"):
             ex = jit_fn.lower(*args).compile()
         _PROGRAM_CACHE[key] = ex
+    else:
+        counter("aot_executable_cache", event="hit").inc()
     return ex
 
 
@@ -106,6 +122,7 @@ def clear_program_cache() -> None:
     """Drop the process-wide builder-executable cache (tests; frees the
     compiled programs' host memory)."""
     _PROGRAM_CACHE.clear()
+    _PROGRAM_SHAPES.clear()
 
 
 def _chunk_structure_ops(tables, pair, dir_tab, alphas, norms_a,
@@ -295,6 +312,44 @@ def choose_ell_split(hist: np.ndarray, n_rows: int, T: int,
     if (n_rows * T - cost[T0]) < 0.15 * n_rows * T:
         T0, S = T, 0
     return T0, S, Tmax
+
+
+def emit_engine_init(eng, engine_kind: str, init_s: Optional[float] = None
+                     ) -> None:
+    """One ``engine_init`` telemetry event carrying the construction split
+    the timer tree measured (structure/plan build with its compile child,
+    transfer, diag) plus the cache outcome flags — the machine-readable
+    form of the warm-start story bench.py reports, shared by both engines
+    so the event schema cannot drift."""
+    t = eng.timer
+    build_s = (t.scope_total("build_structure")
+               + t.scope_total("build_plan"))
+    compile_s = (t.scope_total("build_structure", "compile")
+                 + t.scope_total("build_plan", "compile"))
+    emit("engine_init",
+         engine=engine_kind,
+         mode=eng.mode,
+         n_states=int(eng.n_states),
+         pair=bool(eng.pair),
+         basis_restored=bool(getattr(eng, "basis_restored", False)),
+         structure_restored=bool(getattr(eng, "structure_restored", False)),
+         build_structure_s=round(build_s, 6),
+         compile_s=round(compile_s, 6),
+         kernels_s=round(build_s - compile_s, 6),
+         transfer_s=round(t.scope_total("transfer"), 6),
+         diag_s=round(t.scope_total("diag"), 6),
+         **({} if init_s is None else {"init_s": round(init_s, 6)}))
+
+
+def record_structure_cache(restored: bool, consulted: bool) -> None:
+    """Structure-sidecar cache outcome → ``artifact_cache`` metrics.
+    ``consulted=False`` (no cache path resolved — layer off and no explicit
+    path) records nothing: an engine that never looked is not a miss."""
+    if not consulted:
+        return
+    from ..utils.artifacts import record_cache_event
+
+    record_cache_event("structure", "hit" if restored else "miss")
 
 
 def raise_deferred_failure(eng) -> None:
@@ -497,6 +552,7 @@ class LocalEngine:
     def __init__(self, operator: Operator, batch_size: Optional[int] = None,
                  mode: Optional[str] = None,
                  structure_cache: Optional[str] = None):
+        _t_init = time.perf_counter()
         basis = operator.basis
         #: True when the representatives came from the artifact-cache
         #: checkpoint rather than a fresh enumeration (False when the
@@ -544,12 +600,16 @@ class LocalEngine:
         # ops/bits.build_sorted_lookup): device arrays + static ints.
         pair, dir_tab, self._lk_shift, self._lk_probes = build_sorted_lookup(
             reps, basis.number_bits)
-        with self.timer.scope("transfer"):
+        with self.timer.scope("transfer"), annotate("engine_init/transfer"):
             self._lk_pair = jnp.asarray(pair)         # [N, 2] u32
             self._lk_dir = jnp.asarray(dir_tab)       # [2^b + 1] i32
             self._alphas = jnp.asarray(alphas)        # [N_pad]
             self._norms = jnp.asarray(nrm)            # [N_pad]
             self.tables = K.device_tables(operator, pair=self.pair)
+        counter("bytes_h2d", path="engine_tables").inc(sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(
+                (self._lk_pair, self._lk_dir, self._alphas, self._norms,
+                 self.tables))))
         self.num_terms = int(self.tables.off.x.shape[0])
 
         # NOTE on jit hygiene: every large device array (tables, diag, the
@@ -573,16 +633,22 @@ class LocalEngine:
             structure_cache = self._resolve_structure_cache(structure_cache)
         if mode == "ell":
             self.structure_restored = self._try_load_structure(structure_cache)
+            record_structure_cache(self.structure_restored,
+                                   structure_cache is not None)
             if not self.structure_restored:
-                with self.timer.scope("build_structure"):
+                with self.timer.scope("build_structure"), \
+                        annotate("engine_init/build_structure"):
                     self._build_ell()
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_ell_matvec()
             self._checked = True                  # validated at build time
         elif mode == "compact":
             self.structure_restored = self._try_load_structure(structure_cache)
+            record_structure_cache(self.structure_restored,
+                                   structure_cache is not None)
             if not self.structure_restored:
-                with self.timer.scope("build_structure"):
+                with self.timer.scope("build_structure"), \
+                        annotate("engine_init/build_structure"):
                     self._build_compact()
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_compact_matvec()
@@ -592,6 +658,8 @@ class LocalEngine:
             self._checked = False
         self._warned_traced_check = False
         self._deferred_failure: Optional[str] = None
+        emit_engine_init(self, "local",
+                         init_s=time.perf_counter() - _t_init)
         self.timer.report()  # tree print, gated by display_timings
 
     # -- structure checkpoint (ell/compact) ---------------------------------
@@ -1214,7 +1282,10 @@ class LocalEngine:
         engine-level halt of the reference (DistributedMatrixVector.chpl:113-118).
         In ell mode that check already ran at structure-build time.
         """
-        with self.timer.scope("matvec"):
+        # telemetry measures eager *dispatch* wall time only (async queue —
+        # NO block_until_ready here: recording must never add a sync)
+        _t0 = time.perf_counter()
+        with self.timer.scope("matvec"), annotate("matvec/local"):
             was_complex = self.pair and np.iscomplexobj(x)
             if was_complex:
                 x = K.pair_from_complex(np.asarray(x))
@@ -1249,6 +1320,8 @@ class LocalEngine:
             if check or (check is None and not self._checked):
                 self._validate_counter(int(bad))
                 self._checked = True
+        histogram("matvec_apply_ms", engine="local").observe(
+            (time.perf_counter() - _t0) * 1e3)
         return K.complex_from_pair(np.asarray(y)) if was_complex else y
 
     def _validate_counter(self, bad: int) -> None:

@@ -40,7 +40,22 @@ import jax.numpy as jnp
 import numpy as np
 from scipy.linalg import eigh
 
+from ..obs.events import emit as obs_emit, obs_enabled
+
 __all__ = ["LanczosResult", "lanczos", "lanczos_block"]
+
+
+def _emit_trace(solver: str, it: int, m: int, theta, res) -> None:
+    """One per-convergence-check telemetry event: the current lowest Ritz
+    values and their residual bounds — a stalled eigensolve is diagnosable
+    from the event log alone (``obs_report summarize`` turns these into
+    convergence plot data).  ``theta``/``res`` are small host arrays
+    already; no device fetch happens here."""
+    if not obs_enabled():
+        return
+    obs_emit("lanczos_trace", solver=solver, iter=int(it), basis_size=int(m),
+             ritz=[float(t) for t in np.atleast_1d(theta)],
+             residual=[float(r) for r in np.atleast_1d(res)])
 
 # Row-block size for the blocked Gram-Schmidt sweeps: live basis rows are
 # visited in blocks of this many rows so the sweep cost scales with the
@@ -388,6 +403,8 @@ def lanczos_block(
     first_block_s = 0.0
     first_block_iters = 0
     steady_s = 0.0
+    obs_emit("solver_start", solver="lanczos_block", k=int(k),
+             block_size=int(p), max_iters=int(max_iters), tol=float(tol))
 
     for j in range(max_blocks):
         t0 = _time.perf_counter()
@@ -431,6 +448,7 @@ def lanczos_block(
         theta, S = eigh(T, subset_by_index=(0, kk - 1))
         res = np.linalg.norm(
             np.asarray(B_list[-1]) @ S[m - p:, :], axis=0)
+        _emit_trace("lanczos_block", total, m, theta, res)
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
@@ -455,6 +473,10 @@ def lanczos_block(
         for i in range(kk):
             e = E[:, i]
             evecs.append(e / jnp.sqrt(jnp.real(jnp.vdot(e, e))).astype(dtype))
+    obs_emit("solver_end", solver="lanczos_block", iters=int(total),
+             converged=bool(converged),
+             eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
+             if theta is not None else [])
     return LanczosResult(
         eigenvalues=np.asarray(theta[:kk]) if theta is not None
         else np.zeros(0),
@@ -661,6 +683,11 @@ def lanczos(
     first_block_s = 0.0
     first_block_iters = 0
     steady_s = 0.0
+    obs_emit("solver_start", solver="lanczos", k=int(k),
+             max_iters=int(max_iters), tol=float(tol), pair=bool(pair),
+             max_basis_size=int(mcap), resumed_from=int(resumed_from))
+    if m and theta is not None:
+        _emit_trace("lanczos", total_iters, m, theta, res)
 
     while total_iters < max_iters and not converged:
         if m == mcap:
@@ -707,6 +734,7 @@ def lanczos(
         T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)
         theta, S = eigh(T, subset_by_index=(0, kk - 1))
         res = np.abs(bet[m - 1] * S[m - 1, :])
+        _emit_trace("lanczos", total_iters, m, theta, res)
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
@@ -735,6 +763,10 @@ def lanczos(
             e = E[i]
             enrm = jnp.sqrt(jnp.real(jnp.vdot(e, e)))
             evecs.append((e / enrm.astype(dtype)).reshape(shape))
+    obs_emit("solver_end", solver="lanczos", iters=int(total_iters),
+             converged=bool(converged),
+             eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
+             if theta is not None else [])
     return LanczosResult(
         eigenvalues=np.asarray(theta[:kk]) if theta is not None
         else np.zeros(0),

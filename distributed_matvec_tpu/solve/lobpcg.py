@@ -34,7 +34,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.events import emit as obs_emit
+
 __all__ = ["lobpcg"]
+
+
+def _emit_end(iters: int, evals) -> None:
+    """Final telemetry event (lobpcg_standard's jitted while_loop exposes no
+    per-iteration host callback, so unlike Lanczos the trace granularity
+    here is the solve, not the step)."""
+    obs_emit("solver_end", solver="lobpcg", iters=int(iters),
+             eigenvalues=[float(v) for v in np.atleast_1d(evals)])
 
 
 def _norm_estimate(matvec: Callable, n: int, iters: int = 20, seed: int = 3):
@@ -83,6 +93,8 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     owner = getattr(matvec, "__self__", None)
     if pair is None:
         pair = bool(getattr(owner, "pair", False))
+    obs_emit("solver_start", solver="lobpcg", k=int(k),
+             max_iters=int(max_iters), tol=float(tol), pair=bool(pair))
     dist = owner is not None and hasattr(owner, "from_hashed")
     multi = dist and jax.process_count() > 1
     raw_lobpcg = None
@@ -240,10 +252,12 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             _, evals, U, iters = (run_flipped_multi(block_x0(k)) if multi
                                   else run_flipped(mv_flat, dim,
                                                    block_x0(k)))
+            _emit_end(iters, evals)
             return evals, cols_to_block(U), iters
         if X0 is None:
             X0 = np.random.default_rng(seed).standard_normal((n, k))
         _, evals, U, iters = run_flipped(raw_mv, n, X0)
+        _emit_end(iters, evals)
         return evals, U, iters
 
     # -- pair form: flat realified operator ---------------------------------
@@ -340,5 +354,6 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             f"pair-mode LOBPCG resolved only {len(kept_vals)} of {k} "
             "distinct eigenpairs (unconverged tail); re-run with more "
             "iterations or use solve.lanczos", RuntimeWarning)
+    _emit_end(iters, kept_vals)
     return (np.asarray(kept_vals), np.stack(kept_vecs, axis=1),
             int(iters))

@@ -39,7 +39,7 @@ import os
 from typing import Optional
 
 from .config import get_config
-from .logging import log_debug
+from .logging import log_debug, log_warn
 
 __all__ = [
     "artifact_root",
@@ -51,7 +51,18 @@ __all__ = [
     "make_or_restore_basis",
     "ensure_compilation_cache",
     "within_size_cap",
+    "record_cache_event",
 ]
+
+
+def record_cache_event(kind: str, event: str) -> None:
+    """One artifact-cache outcome into the metrics registry
+    (``artifact_cache{kind=basis|structure, event=hit|miss|save|evict}``)
+    — the single call site engines and this module share, so the report
+    tooling's hit-rate math cannot drift from the recording."""
+    from ..obs.metrics import counter
+
+    counter("artifact_cache", kind=kind, event=event).inc()
 
 _DEFAULT_ROOT = os.path.join(os.path.expanduser("~"), ".cache",
                              "distributed_matvec_tpu", "artifacts")
@@ -126,14 +137,16 @@ def soft_save_structure(sidecar: str, fingerprint: str, mode: str,
 
     nbytes = sum(getattr(v, "nbytes", 0) for v in payload.values())
     if not within_size_cap(nbytes):
+        record_cache_event("structure", "evict")
         log_debug(f"structure artifact save skipped: {nbytes/1e9:.1f} GB "
                   "exceeds artifact_max_gb")
         return False
     try:
         save_engine_structure(sidecar, fingerprint, mode, payload)
     except OSError as e:
-        log_debug(f"structure artifact save skipped: {e!r}")
+        log_warn(f"structure artifact save skipped: {e!r}")
         return False
+    record_cache_event("structure", "save")
     return True
 
 
@@ -189,8 +202,10 @@ def make_or_restore_basis(basis, path: Optional[str] = None,
     if got is not None and got[1] is not None:
         reps, norms = got
         basis.unchecked_set_representatives(reps, norms)
+        record_cache_event("basis", "hit")
         log_debug(f"basis representatives restored from {path}")
         return True
+    record_cache_event("basis", "miss")
     basis.build()
     if not save:
         return False
@@ -217,9 +232,10 @@ def make_or_restore_basis(basis, path: Optional[str] = None,
             except OSError:
                 pass
             raise
+        record_cache_event("basis", "save")
         log_debug(f"basis representatives checkpointed to {path}")
     except OSError as e:
-        log_debug(f"basis artifact save skipped: {e!r}")
+        log_warn(f"basis artifact save skipped: {e!r}")
     return False
 
 

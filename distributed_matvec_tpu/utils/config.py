@@ -25,6 +25,13 @@ class RuntimeConfig:
     profile_dir: str = ""                  # non-empty → jax.profiler traces
     #   (the device-side analog of the reference's kVerboseComm/CommDiagnostics
     #    hooks, DistributedMatrixVector.chpl:19)
+    obs: str = "on"                        # telemetry layer (obs/): metrics
+    #   registry + structured event sink.  "off" (DMT_OBS=off) disables the
+    #   whole layer — every instrument becomes a shared no-op object and the
+    #   hot paths add zero device-side work
+    obs_dir: str = ""                      # event-sink run directory
+    #   (DMT_OBS_DIR): non-empty → append-only JSONL stream per process at
+    #   <obs_dir>/events.p<process_index>.jsonl; empty → in-memory only
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

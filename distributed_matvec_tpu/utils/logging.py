@@ -6,19 +6,34 @@ from __future__ import annotations
 
 import sys
 import time
+from typing import Optional
 
 from .config import get_config
 
-__all__ = ["log_debug", "log_info"]
+__all__ = ["log_debug", "log_info", "log_warn"]
 
 _START = time.time()
 
+# Cached after the FIRST SUCCESSFUL jax.process_index() call: importing jax
+# and querying the backend on every log line costs a dict of module lookups
+# per message (and, before the backend comes up, an exception per line).
+# Failure is deliberately NOT cached.  Caching success is SAFE because the
+# query itself creates the backend, and jax.distributed.initialize() raises
+# ("must be called before any JAX computations") once a backend exists —
+# i.e. a successful query freezes the process topology, so the cached value
+# can never silently go stale (verified against this jaxlib).
+_proc_idx: Optional[int] = None
+
 
 def _process_index() -> int:
+    global _proc_idx
+    if _proc_idx is not None:
+        return _proc_idx
     try:
         import jax
 
-        return jax.process_index()
+        _proc_idx = int(jax.process_index())
+        return _proc_idx
     except Exception:
         return 0
 
@@ -37,3 +52,12 @@ def log_debug(*parts) -> None:
 def log_info(*parts) -> None:
     msg = "".join(str(p) for p in parts)
     print(f"[Info] [{_process_index()}] {msg}", file=sys.stderr, flush=True)
+
+
+def log_warn(*parts) -> None:
+    """Always-on warning level for soft-fail paths (artifact-cache saves,
+    event-sink writes): degraded-but-continuing conditions the user should
+    see once without turning on debug logging, and that must not masquerade
+    as ordinary [Info] progress lines."""
+    msg = "".join(str(p) for p in parts)
+    print(f"[Warn] [{_process_index()}] {msg}", file=sys.stderr, flush=True)

@@ -9,6 +9,7 @@ Perfetto) gated by the ``profile_dir`` config field (``DMT_PROFILE_DIR=…``).
 from __future__ import annotations
 
 from contextlib import contextmanager
+from typing import Optional
 
 from .config import get_config
 
@@ -16,14 +17,21 @@ __all__ = ["maybe_profile"]
 
 
 @contextmanager
-def maybe_profile(create_perfetto_link: bool = False):
-    """Trace the enclosed block when ``config.profile_dir`` is set; otherwise
+def maybe_profile(create_perfetto_link: bool = False,
+                  profile_dir: Optional[str] = None):
+    """Trace the enclosed block when a profile directory is set; otherwise
     a no-op.  Usage::
 
         with maybe_profile():
             y = eng.matvec(x)
+
+    ``profile_dir`` overrides the global ``config.profile_dir`` field for
+    this one block — harnesses (bench.py) can profile exactly one apply per
+    config into its own directory without mutating process-global config or
+    env vars.  An explicit empty string forces the no-op regardless of the
+    config field; ``None`` (default) defers to the config.
     """
-    d = get_config().profile_dir
+    d = profile_dir if profile_dir is not None else get_config().profile_dir
     if not d:
         yield
         return

@@ -97,6 +97,18 @@ class TreeTimer:
                 return 0.0
         return node.total
 
+    def emit(self, kind: str = "timer_tree", **fields) -> Optional[dict]:
+        """Bridge into the telemetry event sink: record the whole timing
+        tree (:meth:`to_dict`) as ONE structured event, so existing timer
+        instrumentation lands in the same JSONL stream the metrics and
+        solver traces use.  Extra ``fields`` ride along (e.g.
+        ``config="chain_16"``).  Returns the event dict, or None when the
+        obs layer is disabled."""
+        from ..obs.events import emit as _emit
+
+        return _emit(kind, timer=self.root.name, tree=self.to_dict(),
+                     **fields)
+
     def report(self, force: bool = False) -> Optional[str]:
         if not (force or get_config().display_timings):
             return None

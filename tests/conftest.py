@@ -19,6 +19,11 @@ os.environ["JAX_ENABLE_X64"] = "true"
 # earlier tests) from ~/.cache, flipping `structure_restored` expectations.
 # Tests that exercise the layer re-enable it against a tmp_path root.
 os.environ["DMT_ARTIFACT_CACHE"] = "off"
+# Telemetry stays ON (default, in-memory — the instrumented hot paths run
+# under test) but never inherits a sink directory from the environment;
+# tests that exercise the JSONL sink point it at tmp_path themselves.
+os.environ.pop("DMT_OBS_DIR", None)
+os.environ.pop("DMT_OBS", None)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,459 @@
+"""Telemetry subsystem (obs/): metrics registry, event sink, TreeTimer
+bridge, report tooling, and the disabled-path zero-overhead guard.
+
+The suite-wide conftest strips ``DMT_OBS_DIR``/``DMT_OBS`` from the
+environment, so the layer runs in its default state here: enabled,
+in-memory only.  Tests that exercise the JSONL sink point it at tmp_path
+and reset the module state around themselves.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu import obs
+from distributed_matvec_tpu.obs import metrics as obs_metrics
+
+# NB: obs.events (the accessor function) shadows the submodule attribute on
+# the package, and `import ... as` resolves through that same attribute —
+# sys.modules holds the real module
+obs_events = sys.modules["distributed_matvec_tpu.obs.events"]
+from distributed_matvec_tpu.utils.timers import TreeTimer
+
+from test_operator import build_heisenberg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(REPO, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def clean_obs():
+    """Fresh event buffer + registry, restored state afterwards."""
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+@pytest.fixture
+def obs_off(monkeypatch):
+    monkeypatch.setenv("DMT_OBS", "off")
+
+
+# ---------------------------------------------------------------------------
+# TreeTimer (satellite: to_dict/scope_total edge cases + emit bridge)
+
+
+def test_treetimer_empty():
+    t = TreeTimer("empty")
+    d = t.to_dict()
+    assert d == {"total": 0.0, "count": 0, "children": {}}
+    assert t.scope_total() == 0.0                  # root, never stopped
+    assert t.scope_total("missing") == 0.0
+    assert t.scope_total("a", "b", "c") == 0.0
+
+
+def test_treetimer_reentered_scope():
+    t = TreeTimer()
+    for _ in range(3):
+        with t.scope("phase"):
+            with t.scope("inner"):
+                pass
+    node = t.root.children["phase"]
+    assert node.count == 3 and len(node.samples) == 3
+    assert node.children["inner"].count == 3
+    d = t.to_dict()
+    assert d["children"]["phase"]["count"] == 3
+    assert d["children"]["phase"]["children"]["inner"]["count"] == 3
+    assert t.scope_total("phase") == pytest.approx(node.total)
+    assert t.scope_total("phase", "inner") >= 0.0
+
+
+def test_treetimer_mean_and_err_n1():
+    t = TreeTimer()
+    with t.scope("once"):
+        pass
+    node = t.root.children["once"]
+    s = node.mean_and_err()
+    assert "±" not in s and "mean" not in s        # n=1: total only
+    assert float(s) == pytest.approx(node.total, abs=1e-6)
+    # n=2 grows the ± suffix
+    with t.scope("once"):
+        pass
+    assert "±" in node.mean_and_err()
+
+
+def test_treetimer_emit_bridge(clean_obs):
+    t = TreeTimer("bridge")
+    with t.scope("a"):
+        with t.scope("b"):
+            pass
+    ev = t.emit(config="unit")
+    assert ev is not None and ev["kind"] == "timer_tree"
+    assert ev["timer"] == "bridge" and ev["config"] == "unit"
+    assert ev["tree"]["children"]["a"]["children"]["b"]["count"] == 1
+    # the event is valid JSON and landed in the in-memory buffer
+    json.loads(json.dumps(ev))
+    assert obs.events("timer_tree")[-1]["seq"] == ev["seq"]
+
+
+def test_treetimer_emit_disabled(clean_obs, obs_off):
+    t = TreeTimer()
+    with t.scope("a"):
+        pass
+    assert t.emit() is None
+    assert obs.events() == []
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+
+
+def test_counter_labeling(clean_obs):
+    obs.counter("hits", engine="local").inc()
+    obs.counter("hits", engine="local").inc(2)
+    obs.counter("hits", engine="distributed").inc(5)
+    obs.counter("hits").inc(7)
+    snap = obs.snapshot()["counters"]
+    assert snap["hits{engine=local}"] == 3
+    assert snap["hits{engine=distributed}"] == 5
+    assert snap["hits"] == 7
+    # label ORDER is canonicalized: same series either way
+    obs.counter("c", a="1", b="2").inc()
+    obs.counter("c", b="2", a="1").inc()
+    assert obs.snapshot()["counters"]["c{a=1,b=2}"] == 2
+
+
+def test_gauge(clean_obs):
+    obs.gauge("bytes", what="tables").set(123.5)
+    obs.gauge("bytes", what="tables").set(7)
+    assert obs.snapshot()["gauges"]["bytes{what=tables}"] == 7.0
+
+
+def test_histogram_bucketing(clean_obs):
+    h = obs.histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5.0, 99.0, 1e6):
+        h.observe(v)
+    d = h.to_dict()
+    # bounds are inclusive: 1.0 lands in the first bucket; 1e6 overflows
+    assert d["buckets"] == [1.0, 10.0, 100.0]
+    assert d["counts"] == [2, 1, 1, 1]
+    assert d["count"] == 5
+    assert d["sum"] == pytest.approx(0.5 + 1.0 + 5.0 + 99.0 + 1e6)
+    assert h.mean == pytest.approx(d["sum"] / 5)
+    snap = obs.snapshot()["histograms"]["lat_ms"]
+    assert snap["counts"] == [2, 1, 1, 1]
+    with pytest.raises(ValueError):
+        obs_metrics.Histogram(buckets=(5.0, 1.0))
+
+
+def test_metrics_disabled_null(clean_obs, obs_off):
+    assert obs.counter("x") is obs_metrics.NULL
+    assert obs.gauge("x") is obs_metrics.NULL
+    assert obs.histogram("x") is obs_metrics.NULL
+    obs.counter("x", a="b").inc(5)                 # all no-ops
+    obs.histogram("x").observe(1.0)
+    assert obs.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+
+
+# ---------------------------------------------------------------------------
+# event sink
+
+
+def test_emit_envelope_and_buffer(clean_obs):
+    e0 = obs.emit("alpha", x=1)
+    e1 = obs.emit("beta", y=[1, 2])
+    assert (e0["seq"], e1["seq"]) == (0, 1)        # monotonic per process
+    assert e0["kind"] == "alpha" and e0["proc"] == 0 and e0["ts"] > 0
+    assert [e["kind"] for e in obs.events()] == ["alpha", "beta"]
+    assert obs.events("beta") == [e1]
+
+
+def test_jsonl_round_trip(clean_obs, tmp_path, monkeypatch):
+    run = tmp_path / "run"
+    monkeypatch.setenv("DMT_OBS_DIR", str(run))
+    obs.emit("one", arr=np.arange(3), val=np.float64(2.5),
+             n=np.int64(7))
+    obs.emit("two", nested={"a": [1.5, 2.5]})
+    obs.flush()
+    path = obs.event_path()
+    assert path == str(run / "events.p0.jsonl")
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().splitlines()]
+    assert [e["kind"] for e in lines] == ["one", "two"]
+    assert lines[0]["arr"] == [0, 1, 2]            # numpy made plain
+    assert lines[0]["val"] == 2.5 and lines[0]["n"] == 7
+    assert [e["seq"] for e in lines] == [0, 1]
+    obs.reset()                                    # release the file handle
+
+
+def test_sink_write_fails_soft(clean_obs, monkeypatch, capsys):
+    # /dev/null/... cannot be created: the sink must warn once, disable
+    # itself, and keep the in-memory stream alive — never raise
+    monkeypatch.setenv("DMT_OBS_DIR", "/dev/null/nope")
+    e = obs.emit("still_recorded", i=0)
+    assert e is not None
+    obs.emit("still_recorded", i=1)
+    assert len(obs.events("still_recorded")) == 2
+    err = capsys.readouterr().err
+    assert err.count("event sink disabled") == 1   # warned ONCE
+
+
+def test_emit_disabled(clean_obs, obs_off):
+    assert obs.emit("nope") is None
+    assert obs.events() == []
+    assert not obs.obs_enabled()
+
+
+# ---------------------------------------------------------------------------
+# engine integration + the disabled-path zero-overhead guard
+
+
+def test_engine_emits_init_and_apply_metrics(clean_obs, rng):
+    from distributed_matvec_tpu.parallel.engine import (LocalEngine,
+                                                        clear_program_cache)
+    op = build_heisenberg(10, 5, None, ())
+    # earlier tests may have warmed the process-wide AOT cache; a cold one
+    # makes the compile/retrace counters deterministic
+    clear_program_cache()
+    eng = LocalEngine(op, mode="ell")
+    inits = obs.events("engine_init")
+    assert inits and inits[-1]["engine"] == "local"
+    ev = inits[-1]
+    assert ev["mode"] == "ell" and ev["n_states"] == op.basis.number_states
+    for key in ("build_structure_s", "compile_s", "transfer_s", "diag_s",
+                "init_s", "structure_restored", "basis_restored"):
+        assert key in ev
+    # cold build in a fresh registry: AOT executables were compiled — but a
+    # healthy cold start compiles each distinct program ONCE, which is NOT
+    # a retrace
+    snap = obs.snapshot()["counters"]
+    assert snap.get("aot_executable_cache{event=compile}", 0) >= 1
+    assert snap.get("retrace_count", 0) == 0
+    # same builder programs at a different shape key: a genuine retrace
+    from distributed_matvec_tpu.parallel.engine import pad_to_multiple
+    LocalEngine(op, mode="ell",
+                batch_size=pad_to_multiple(op.basis.number_states, 8) // 2)
+    assert obs.snapshot()["counters"].get("retrace_count", 0) >= 1
+
+    x = rng.random(op.basis.number_states) - 0.5
+    before = obs.histogram("matvec_apply_ms", engine="local").count
+    eng.matvec(x)
+    after = obs.histogram("matvec_apply_ms", engine="local").count
+    assert after == before + 1
+
+
+def test_engine_apply_disabled_zero_overhead(clean_obs, rng, monkeypatch):
+    """The acceptance guard: with the layer off, an engine apply records
+    nothing, touches no sink, and returns bit-identical results."""
+    op = build_heisenberg(10, 5, None, ())
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    eng = LocalEngine(op, mode="ell")
+    x = rng.random(op.basis.number_states) - 0.5
+    y_on = np.asarray(eng.matvec(x))
+
+    monkeypatch.setenv("DMT_OBS", "off")
+    obs.reset_all()
+
+    def _explode(*a, **k):                         # any sink touch is a bug
+        raise AssertionError("obs layer touched while disabled")
+
+    monkeypatch.setattr(obs_events, "_write", _explode)
+    assert obs.histogram("matvec_apply_ms", engine="local") \
+        is obs_metrics.NULL
+    y_off = np.asarray(eng.matvec(x))
+    np.testing.assert_array_equal(y_on, y_off)
+    assert obs.events() == []
+    assert obs.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+
+
+def test_lanczos_emits_convergence_trace(clean_obs, rng):
+    op = build_heisenberg(10, 5, None, ())
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    from distributed_matvec_tpu.solve import lanczos
+    eng = LocalEngine(op, mode="ell")
+    res = lanczos(eng.matvec, op.basis.number_states, k=1, max_iters=48,
+                  tol=1e-10, seed=3)
+    traces = obs.events("lanczos_trace")
+    assert traces, "no convergence trace emitted"
+    # residuals decrease to convergence; the last trace matches the result
+    last = traces[-1]
+    assert last["ritz"][0] == pytest.approx(float(res.eigenvalues[0]))
+    ends = obs.events("solver_end")
+    assert ends and ends[-1]["converged"] == res.converged
+
+
+# ---------------------------------------------------------------------------
+# obs_report
+
+
+def _write_detail(path, device_ms, iters_per_s=100.0):
+    detail = {"chain_16": {"config": "heisenberg_chain_16",
+                           "device_ms": device_ms,
+                           "engine_init_s": 1.0,
+                           "lanczos_iters_per_s": iters_per_s},
+              "broken": {"error": "Boom()"}}
+    path.write_text(json.dumps(detail))
+    return str(path)
+
+
+def test_obs_report_diff_regression(tmp_path):
+    rep = _load_obs_report()
+    base = _write_detail(tmp_path / "base.json", device_ms=10.0)
+    ok = _write_detail(tmp_path / "ok.json", device_ms=11.0)
+    bad = _write_detail(tmp_path / "bad.json", device_ms=13.0)
+    # +10% within a 20% gate; +30% beyond it → exit 1
+    assert rep.main(["diff", base, ok, "--threshold", "0.2"]) == 0
+    assert rep.main(["diff", base, bad, "--threshold", "0.2"]) == 1
+    # improvement is never a regression
+    assert rep.main(["diff", bad, base, "--threshold", "0.2"]) == 0
+    # direction-aware: a rate metric gates on DECREASE
+    slow = _write_detail(tmp_path / "slow.json", device_ms=10.0,
+                         iters_per_s=50.0)
+    assert rep.main(["diff", base, slow, "--threshold", "0.2",
+                     "--metric", "lanczos_iters_per_s"]) == 1
+    assert rep.main(["diff", slow, base, "--threshold", "0.2",
+                     "--metric", "lanczos_iters_per_s"]) == 0
+    # no overlap at all is its own (non-zero) failure mode
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert rep.main(["diff", base, str(empty)]) == 2
+
+
+def test_obs_report_diff_config_filter(tmp_path):
+    rep = _load_obs_report()
+    base = _write_detail(tmp_path / "b.json", device_ms=10.0)
+    bad = _write_detail(tmp_path / "n.json", device_ms=20.0)
+    # the regressed config filtered OUT → nothing in common → rc 2
+    assert rep.main(["diff", base, bad, "--config", "kagome"]) == 2
+    assert rep.main(["diff", base, bad, "--config", "chain_16"]) == 1
+
+
+def test_obs_report_summarize_run_dir(clean_obs, tmp_path, monkeypatch):
+    """A crafted run (engine init + solver trace + snapshot, two procs)
+    reconstructs the init split, cache hit rate, and residual series."""
+    rep = _load_obs_report()
+    run = tmp_path / "run"
+    monkeypatch.setenv("DMT_OBS_DIR", str(run))
+    obs.emit("engine_init", engine="local", mode="ell", n_states=100,
+             pair=False, basis_restored=True, structure_restored=False,
+             build_structure_s=2.0, compile_s=0.5, kernels_s=1.5,
+             transfer_s=0.25, diag_s=0.125, init_s=3.0)
+    obs.emit("solver_start", solver="lanczos", k=1, tol=1e-10)
+    obs.emit("lanczos_trace", solver="lanczos", iter=16, basis_size=16,
+             ritz=[-28.1], residual=[1.0])
+    obs.emit("lanczos_trace", solver="lanczos", iter=32, basis_size=32,
+             ritz=[-28.5], residual=[1e-11])
+    obs.emit("solver_end", solver="lanczos", iters=32, converged=True,
+             eigenvalues=[-28.5])
+    obs.emit("bench_result", config="heisenberg_chain_16", device_ms=2.5,
+             n_states=100)
+    obs.emit("metrics_snapshot", metrics={"counters": {
+        "artifact_cache{event=hit,kind=structure}": 3,
+        "artifact_cache{event=miss,kind=structure}": 1,
+        "aot_executable_cache{event=hit}": 7,
+        "aot_executable_cache{event=compile}": 1,
+        "bytes_h2d{path=engine_tables}": 1024,
+        "retrace_count": 1}})
+    obs.flush()
+    obs.reset()
+    # a second process's stream must merge in (proc, seq) order
+    (run / "events.p1.jsonl").write_text(json.dumps(
+        {"seq": 0, "ts": 0.0, "proc": 1, "kind": "engine_init",
+         "engine": "distributed", "mode": "ell", "n_states": 100,
+         "basis_restored": False, "structure_restored": True,
+         "build_structure_s": 0.0, "compile_s": 0.0, "kernels_s": 0.0,
+         "transfer_s": 0.1, "diag_s": 0.0, "init_s": 0.2}) + "\n")
+
+    s = rep.run_summary(rep.load_events(str(run)))
+    assert s["processes"] == [0, 1]
+    assert len(s["engine_inits"]) == 2
+    local = s["engine_inits"][0]
+    assert (local["build_structure_s"], local["compile_s"],
+            local["transfer_s"]) == (2.0, 0.5, 0.25)
+    caches = s["cache"]["caches"]
+    assert caches["artifact_cache/structure"]["hit_rate"] == 0.75
+    assert caches["aot_executable_cache"]["hit_rate"] == pytest.approx(7 / 8)
+    assert s["cache"]["bytes_h2d"] == 1024
+    assert s["cache"]["retrace_count"] == 1
+    sv = s["solvers"][0]
+    assert sv["converged"] is True
+    assert [t["iter"] for t in sv["trace"]] == [16, 32]
+    assert sv["trace"][-1]["residual"] == [1e-11]
+    assert s["bench"]["heisenberg_chain_16"]["device_ms"] == 2.5
+    # the human renderer must not throw on the same summary
+    rep.print_summary(s)
+
+
+def test_obs_report_load_events_jsonl_and_torn_line(tmp_path, capsys):
+    rep = _load_obs_report()
+    f = tmp_path / "e.jsonl"
+    f.write_text(json.dumps({"seq": 0, "proc": 0, "kind": "a"}) + "\n"
+                 + '{"seq": 1, "proc": 0, "ki')       # torn final line
+    evs = rep.load_events(str(f))
+    assert [e["kind"] for e in evs] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# satellites: logging + profiling
+
+
+def test_log_warn_and_process_index_cache(capsys):
+    from distributed_matvec_tpu.utils import logging as L
+    L.log_warn("disk ", "full")
+    err = capsys.readouterr().err
+    assert "[Warn] [0] disk full" in err
+    # cached after first success: later calls never re-query jax
+    assert L._proc_idx is not None
+    assert L._process_index() == L._proc_idx
+
+
+def test_maybe_profile_override(monkeypatch, tmp_path):
+    from distributed_matvec_tpu.utils import profiling
+    from distributed_matvec_tpu.utils.config import update_config
+    calls = []
+
+    class _Trace:
+        def __init__(self, d, create_perfetto_link=False):
+            calls.append(d)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    import jax
+    monkeypatch.setattr(jax.profiler, "trace", _Trace)
+    # config field unset: no-op
+    update_config(profile_dir="")
+    with profiling.maybe_profile():
+        pass
+    assert calls == []
+    # explicit override wins without touching global config
+    with profiling.maybe_profile(profile_dir=str(tmp_path / "p")):
+        pass
+    assert calls == [str(tmp_path / "p")]
+    # config fallback still works; explicit "" forces the no-op over it
+    update_config(profile_dir=str(tmp_path / "cfg"))
+    try:
+        with profiling.maybe_profile():
+            pass
+        assert calls[-1] == str(tmp_path / "cfg")
+        with profiling.maybe_profile(profile_dir=""):
+            pass
+        assert calls[-1] == str(tmp_path / "cfg")  # unchanged
+    finally:
+        update_config(profile_dir="")

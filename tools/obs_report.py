@@ -1,0 +1,436 @@
+#!/usr/bin/env python
+"""obs_report — read, summarize, diff, and tail telemetry runs.
+
+The reader side of the ``distributed_matvec_tpu/obs`` subsystem.  A *run* is
+either
+
+* a run directory written under ``DMT_OBS_DIR`` (one
+  ``events.p<proc>.jsonl`` per process, ordered by ``(proc, seq)``),
+* a single ``.jsonl`` event file, or
+* a bench detail JSON (``BENCH_DETAIL.json`` — ``{config_key: {metrics}}``),
+  which is treated as a run containing only ``bench_result`` events so the
+  recorded benchmark artifacts diff directly against live runs.
+
+Subcommands::
+
+    summarize RUN [--json]
+        One run → engine-init split table (structure/compile/transfer/diag),
+        artifact-cache hit rates + AOT executable-cache reuse + transfer
+        volume from the final metrics snapshot, per-config bench metrics,
+        and solver convergence traces (iteration → Ritz value/residual —
+        ready-to-plot data).
+
+    diff BASELINE NEW [--threshold 0.2] [--metric device_ms ...]
+                      [--config NAME ...] [--all-metrics]
+        Two runs → per-config relative change of every comparable numeric
+        metric; exits 1 when any *gated* metric regressed beyond the
+        threshold (default gate: device_ms; direction-aware — ms/seconds
+        up is a regression, iters-per-second down is).  This is the CI
+        perf gate `make obs-check` runs against the recorded
+        BENCH_DETAIL.json.
+
+    tail RUN [-n 20] [--follow]
+        Human-readable view of the last events; ``--follow`` keeps reading
+        as a live run appends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+# Metrics where a LOWER value in the new run is the regression (rates,
+# speedups); everything else numeric is treated as cost-like (ms, seconds,
+# bytes, iteration counts) where HIGHER is the regression.
+_HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate")
+
+_DEFAULT_GATE = ("device_ms",)
+
+
+def _is_higher_better(metric: str) -> bool:
+    return any(tag in metric for tag in _HIGHER_IS_BETTER)
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+
+def load_events(path: str) -> List[dict]:
+    """Events of one run, ordered by (proc, seq).  Accepts a run directory,
+    one .jsonl file, or a BENCH_DETAIL-style .json (synthesized into
+    ``bench_result`` events)."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+        if not files:
+            raise FileNotFoundError(f"no events.p*.jsonl under {path}")
+        evs = []
+        for f in files:
+            evs.extend(_read_jsonl(f))
+        evs.sort(key=lambda e: (e.get("proc", 0), e.get("seq", 0)))
+        return evs
+    if path.endswith(".jsonl"):
+        return _read_jsonl(path)
+    with open(path) as f:
+        detail = json.load(f)
+    if not isinstance(detail, dict):
+        raise ValueError(f"{path}: expected a JSON object of configs")
+    evs = []
+    for i, (key, rec) in enumerate(sorted(detail.items())):
+        if not isinstance(rec, dict) or "error" in rec:
+            continue
+        evs.append({"seq": i, "proc": 0, "kind": "bench_result",
+                    "config": rec.get("config", key), **rec})
+    return evs
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    evs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evs.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                # a torn final line from a live/killed writer is expected;
+                # anything mid-file is worth a loud stderr note
+                print(f"[obs_report] skipping unparseable line "
+                      f"{path}:{ln}: {e}", file=sys.stderr)
+    return evs
+
+
+# ---------------------------------------------------------------------------
+# summarize
+
+
+def bench_metrics(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """{config_name: {metric: number}} from ``bench_result`` events (last
+    event per config wins — reruns supersede)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("kind") != "bench_result":
+            continue
+        cfg = str(ev.get("config", "unknown"))
+        out[cfg] = {k: v for k, v in ev.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and k not in ("seq", "ts", "proc")}
+    return out
+
+
+def _cache_rates(snap: dict) -> dict:
+    """Hit rates + transfer totals from a metrics snapshot's counters."""
+    counters = snap.get("counters", {})
+    agg: Dict[str, Dict[str, int]] = {}
+    bytes_io = {"bytes_h2d": 0, "bytes_d2h": 0}
+    retrace = 0
+    for name, val in counters.items():
+        base = name.split("{", 1)[0]
+        if base in ("artifact_cache", "aot_executable_cache"):
+            event = kind = ""
+            if "{" in name:
+                for part in name[name.index("{") + 1:-1].split(","):
+                    k, _, v = part.partition("=")
+                    if k == "event":
+                        event = v
+                    elif k == "kind":
+                        kind = v
+            key = f"{base}/{kind}" if kind else base
+            agg.setdefault(key, {}).setdefault(event, 0)
+            agg[key][event] += int(val)
+        elif base in bytes_io:
+            bytes_io[base] += int(val)
+        elif base == "retrace_count":
+            retrace += int(val)
+    rates = {}
+    for key, ev in sorted(agg.items()):
+        hits = ev.get("hit", 0)
+        misses = ev.get("miss", 0) + ev.get("compile", 0)
+        total = hits + misses
+        rates[key] = dict(ev, hit_rate=round(hits / total, 4) if total
+                          else None)
+    return {"caches": rates, **bytes_io, "retrace_count": retrace}
+
+
+def run_summary(events: List[dict]) -> dict:
+    """The machine-readable summary ``summarize`` renders."""
+    inits = [{k: ev.get(k) for k in
+              ("proc", "engine", "mode", "n_states", "basis_restored",
+               "structure_restored", "init_s", "build_structure_s",
+               "compile_s", "kernels_s", "transfer_s", "diag_s")}
+             for ev in events if ev.get("kind") == "engine_init"]
+
+    solvers = []
+    cur: Optional[dict] = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "solver_start":
+            cur = {"solver": ev.get("solver"), "proc": ev.get("proc"),
+                   "k": ev.get("k"), "tol": ev.get("tol"), "trace": []}
+            solvers.append(cur)
+        elif kind == "lanczos_trace":
+            if cur is None or ev.get("solver") != cur["solver"]:
+                cur = {"solver": ev.get("solver"), "proc": ev.get("proc"),
+                       "trace": []}
+                solvers.append(cur)
+            cur["trace"].append({"iter": ev.get("iter"),
+                                 "basis_size": ev.get("basis_size"),
+                                 "ritz": ev.get("ritz"),
+                                 "residual": ev.get("residual")})
+        elif kind == "solver_end" and cur is not None \
+                and ev.get("solver") == cur["solver"]:
+            cur.update(iters=ev.get("iters"), converged=ev.get("converged"),
+                       eigenvalues=ev.get("eigenvalues"))
+            cur = None
+
+    snaps = [ev for ev in events if ev.get("kind") == "metrics_snapshot"]
+    cache = _cache_rates(snaps[-1].get("metrics", {})) if snaps else None
+
+    return {"n_events": len(events),
+            "processes": sorted({ev.get("proc", 0) for ev in events}),
+            "engine_inits": inits,
+            "cache": cache,
+            "bench": bench_metrics(events),
+            "solvers": solvers}
+
+
+def _fmt_seconds(v) -> str:
+    return f"{'-':>8}" if v is None else f"{v:8.3f}"
+
+
+def print_summary(s: dict) -> None:
+    print(f"events: {s['n_events']}  processes: {s['processes']}")
+    if s["engine_inits"]:
+        print("\nengine inits (seconds; split from the construction timers):")
+        print(f"  {'engine':<12} {'mode':<8} {'N':<10}"
+              f"{'init':>8} {'build':>8} {'compile':>8} {'kernels':>8}"
+              f"{'transfer':>9} {'diag':>8}  restored(basis/structure)")
+        for e in s["engine_inits"]:
+            print(f"  {str(e['engine']):<12} {str(e['mode']):<8} "
+                  f"{str(e['n_states']):<10}"
+                  f"{_fmt_seconds(e['init_s'])} "
+                  f"{_fmt_seconds(e['build_structure_s'])} "
+                  f"{_fmt_seconds(e['compile_s'])} "
+                  f"{_fmt_seconds(e['kernels_s'])} "
+                  f"{_fmt_seconds(e['transfer_s']):>9} "
+                  f"{_fmt_seconds(e['diag_s'])}  "
+                  f"{bool(e['basis_restored'])}/"
+                  f"{bool(e['structure_restored'])}")
+    if s["cache"]:
+        c = s["cache"]
+        print("\ncache / transfer totals (final metrics snapshot):")
+        for key, ev in c["caches"].items():
+            rate = ev.get("hit_rate")
+            counts = " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                              if k != "hit_rate")
+            print(f"  {key:<28} {counts}"
+                  + (f"  hit_rate={rate:.1%}" if rate is not None else ""))
+        print(f"  bytes_h2d={c['bytes_h2d']}  bytes_d2h={c['bytes_d2h']}  "
+              f"retrace_count={c['retrace_count']}")
+    if s["bench"]:
+        print("\nbench results:")
+        for cfg, m in sorted(s["bench"].items()):
+            keys = ("n_states", "engine_init_s", "device_ms",
+                    "batch4_ms_per_vector", "lanczos_iters_per_s")
+            line = "  ".join(f"{k}={m[k]}" for k in keys if k in m)
+            print(f"  {cfg:<28} {line}")
+    for sv in s["solvers"]:
+        trace = sv.get("trace", [])
+        head = (f"\nsolver {sv.get('solver')} (proc {sv.get('proc')}): "
+                f"iters={sv.get('iters')} converged={sv.get('converged')}")
+        if sv.get("eigenvalues"):
+            head += f" E0={sv['eigenvalues'][0]:.10f}"
+        print(head)
+        if trace:
+            print("  iter   basis    ritz[0]            max|residual|")
+            for t in trace:
+                ritz = (t.get("ritz") or [float("nan")])[0]
+                res = max(t.get("residual") or [float("nan")])
+                print(f"  {str(t.get('iter')):<6} {str(t.get('basis_size')):<8}"
+                      f" {ritz:<18.12g} {res:.3e}")
+
+
+# ---------------------------------------------------------------------------
+# diff
+
+
+def diff_runs(base: Dict[str, Dict[str, float]],
+              new: Dict[str, Dict[str, float]],
+              threshold: float,
+              gate_metrics: Optional[List[str]] = None,
+              configs: Optional[List[str]] = None):
+    """Compare per-config bench metrics.  Returns (rows, regressions):
+    ``rows`` is every (config, metric, base, new, rel_change, gated) over
+    the intersection; ``regressions`` the gated rows beyond threshold.
+    Config selection matches by substring so `--config chain_16` finds
+    `heisenberg_chain_16`."""
+    gate = list(gate_metrics) if gate_metrics else list(_DEFAULT_GATE)
+    rows, regressions = [], []
+    common = [c for c in sorted(base) if c in new]
+    if configs:
+        common = [c for c in common
+                  if any(sel in c for sel in configs)]
+    for cfg in common:
+        for metric in sorted(set(base[cfg]) & set(new[cfg])):
+            b, n = base[cfg][metric], new[cfg][metric]
+            if not b:
+                continue
+            rel = (n - b) / abs(b)
+            worse = -rel if _is_higher_better(metric) else rel
+            gated = metric in gate
+            rows.append((cfg, metric, b, n, rel, gated))
+            if gated and worse > threshold:
+                regressions.append((cfg, metric, b, n, rel))
+    return rows, regressions, common
+
+
+def print_diff(rows, regressions, common, threshold, all_metrics) -> None:
+    if not common:
+        print("diff: no common configs between the two runs", file=sys.stderr)
+        return
+    print(f"configs compared: {', '.join(common)}")
+    print(f"{'config':<28} {'metric':<26} {'base':>12} {'new':>12} "
+          f"{'change':>8}  gate")
+    for cfg, metric, b, n, rel, gated in rows:
+        if not (all_metrics or gated or abs(rel) > threshold):
+            continue
+        print(f"{cfg:<28} {metric:<26} {b:>12.4g} {n:>12.4g} "
+              f"{rel:>+7.1%}  {'*' if gated else ''}")
+    if regressions:
+        print(f"\nREGRESSION: {len(regressions)} gated metric(s) beyond "
+              f"{threshold:.0%}:")
+        for cfg, metric, b, n, rel in regressions:
+            print(f"  {cfg}: {metric} {b:.4g} -> {n:.4g} ({rel:+.1%})")
+    else:
+        print(f"\nno gated regression beyond {threshold:.0%}")
+
+
+# ---------------------------------------------------------------------------
+# tail
+
+
+def _fmt_event(ev: dict) -> str:
+    envelope = ("seq", "ts", "proc", "kind")
+    ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+    payload = " ".join(f"{k}={_short(v)}" for k, v in ev.items()
+                       if k not in envelope)
+    return (f"{ts} p{ev.get('proc', 0)} #{ev.get('seq', 0):<5} "
+            f"{ev.get('kind', '?'):<18} {payload}")
+
+
+def _short(v, cap: int = 60) -> str:
+    s = json.dumps(v, default=repr) if isinstance(v, (dict, list)) else str(v)
+    return s if len(s) <= cap else s[: cap - 3] + "..."
+
+
+def tail_run(path: str, n: int, follow: bool) -> None:
+    evs = load_events(path)
+    for ev in evs[-n:]:
+        print(_fmt_event(ev))
+    if not follow:
+        return
+    if not os.path.isdir(path) and not path.endswith(".jsonl"):
+        print("--follow needs a run directory or .jsonl file",
+              file=sys.stderr)
+        return
+    files = (sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+             if os.path.isdir(path) else [path])
+    offsets = {f: os.path.getsize(f) for f in files}
+    partial: Dict[str, str] = {}
+    try:
+        while True:
+            time.sleep(0.5)
+            if os.path.isdir(path):  # pick up files of late-joining procs
+                files = sorted(glob.glob(
+                    os.path.join(path, "events.p*.jsonl")))
+            for f in files:
+                size = os.path.getsize(f)
+                off = offsets.get(f, 0)
+                if size <= off:
+                    continue
+                with open(f) as fh:
+                    fh.seek(off)
+                    chunk = fh.read(size - off)
+                offsets[f] = size
+                # a read can land mid-write: keep the torn final fragment
+                # buffered until its newline arrives instead of dropping
+                # the event
+                data = partial.pop(f, "") + chunk
+                lines = data.split("\n")
+                if lines[-1]:
+                    partial[f] = lines[-1]
+                for line in lines[:-1]:
+                    if not line.strip():
+                        continue
+                    try:
+                        print(_fmt_event(json.loads(line)))
+                    except json.JSONDecodeError:
+                        pass
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_report", description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summarize", help="one run -> human/JSON summary")
+    p.add_argument("run", help="run dir, .jsonl file, or BENCH_DETAIL.json")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable summary dict")
+
+    p = sub.add_parser("diff", help="two runs -> regression report "
+                                    "(exit 1 on gated regression)")
+    p.add_argument("base", help="baseline run (dir/.jsonl/.json)")
+    p.add_argument("new", help="candidate run (dir/.jsonl/.json)")
+    p.add_argument("--threshold", type=float, default=0.2,
+                   help="gated relative regression bound (default 0.2)")
+    p.add_argument("--metric", action="append", default=None,
+                   help="gate on this metric (repeatable; default device_ms)")
+    p.add_argument("--config", action="append", default=None,
+                   help="only configs whose name contains this substring")
+    p.add_argument("--all-metrics", action="store_true",
+                   help="print every common metric, not just gated/changed")
+
+    p = sub.add_parser("tail", help="view the last events of a run")
+    p.add_argument("run")
+    p.add_argument("-n", type=int, default=20)
+    p.add_argument("--follow", action="store_true",
+                   help="keep reading as the run appends")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "summarize":
+        summary = run_summary(load_events(args.run))
+        if args.json:
+            print(json.dumps(summary, indent=1, sort_keys=True))
+        else:
+            print_summary(summary)
+        return 0
+
+    if args.cmd == "diff":
+        base = bench_metrics(load_events(args.base))
+        new = bench_metrics(load_events(args.new))
+        rows, regressions, common = diff_runs(
+            base, new, args.threshold, args.metric, args.config)
+        print_diff(rows, regressions, common, args.threshold,
+                   args.all_metrics)
+        if not common:
+            return 2
+        return 1 if regressions else 0
+
+    tail_run(args.run, args.n, args.follow)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
