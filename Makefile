@@ -8,13 +8,16 @@ PYTHON ?= python
 # relative regression bound on the gated metrics)
 OBS_CHECK_DIR ?= /tmp/dmt_obs_check
 OBS_THRESHOLD ?= 0.2
+# health-check gate: max relative probe overhead on chain-16 device_ms
+HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
-	obs-check clean
+	obs-check health-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
 	$(MAKE) obs-check
+	$(MAKE) health-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -63,6 +66,16 @@ obs-check:
 	      "(timing noise vs a genuine regression resolves by attempt 3)"; \
 	  fi; \
 	done; exit $$ok
+
+# Numerical-health gate (tools/health_check.py): chain-16 smoke applies
+# with probes on vs off in ONE process (same warm engine — cross-process
+# wall-clock would measure cache state, not probe cost), asserting the
+# probe overhead on device_ms stays under HEALTH_THRESHOLD and that a
+# healthy probes-on Lanczos solve emits ZERO health warnings.  Retries
+# live inside the tool (same noise rationale as obs-check above).
+health-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/health_check.py \
+	  --threshold $(HEALTH_THRESHOLD)
 
 clean:
 	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null; true

@@ -96,8 +96,17 @@ def main(argv=None):
     ap.add_argument("--obs-dir", default=None, metavar="DIR",
                     help="telemetry run directory (sets obs_dir / "
                          "DMT_OBS_DIR): engine-init splits, solver "
-                         "convergence traces, and phase timings stream to "
-                         "DIR/events.p<rank>.jsonl for tools/obs_report.py")
+                         "convergence traces, rank-tagged apply events, and "
+                         "phase timings stream to DIR/rank_<r>/events.jsonl "
+                         "for tools/obs_report.py (merge / report --ranks "
+                         "for multi-rank runs)")
+    ap.add_argument("--health", choices=("on", "strict", "off"),
+                    default=None,
+                    help="numerical-health watchdog (DMT_HEALTH): on = "
+                         "log-and-continue (default), strict = critical "
+                         "conditions (NaN/Inf outputs, exchange overflow, "
+                         "Lanczos breakdown) raise HealthError, off = no "
+                         "probes")
     args = ap.parse_args(argv)
     if args.mode is None:
         args.mode = "fused" if args.shards else "ell"
@@ -112,6 +121,12 @@ def main(argv=None):
 
     if args.obs_dir:
         update_config(obs_dir=args.obs_dir)
+    if args.health:
+        # the env var outranks the config field (per-subprocess override
+        # contract), so the CLI must set BOTH or an inherited DMT_HEALTH
+        # would silently drop the mode requested on the command line
+        os.environ["DMT_HEALTH"] = args.health
+        update_config(health=args.health)
 
     if args.coordinator or args.num_processes:
         from distributed_matvec_tpu.parallel.mesh import init_distributed

@@ -91,6 +91,7 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
                       else None)).encode()).hexdigest()[:12]
             ck = os.path.join(cache_dir, f"{name}-{ident}.h5")
     obs.emit("bench_config_start", config=name)
+    h_before = obs.health_event_count()
     _progress(f"{name}: building basis")
     t0 = time.perf_counter()
     op = _build_op(basis_args, n_sites, edges)
@@ -231,6 +232,9 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
             out["lanczos_rate_includes_compile"] = True
         out["lanczos_total_s"] = round(dt, 2)
         out["lanczos_e0"] = float(res.eigenvalues[0])
+    # numerical-health tally for the config (drains pending probe fetches):
+    # zero is the healthy reading (the health-check gate asserts it)
+    out["health_events"] = obs.health_event_count() - h_before
     # recording rides the telemetry layer: the per-config record is ONE
     # bench_result event next to the engine_init / lanczos_trace events the
     # construction and solve above already emitted, and the timing tree

@@ -15,11 +15,18 @@ visibility GSPMD (arXiv:2105.04663) treats as a first-class signal):
   ``artifact_cache{event=hit}``, ``bytes_h2d``, ``retrace_count``);
   :func:`snapshot` turns the registry into plain data.
 * :mod:`~.events` — append-only JSONL per process
-  (``<run_dir>/events.p<proc>.jsonl``, monotonic ``seq``, soft-fail
-  writes), an in-memory ring buffer, and :func:`annotate` spans that line
-  the JSONL timeline up with ``jax.profiler`` Perfetto traces.
-* ``tools/obs_report.py`` — the reader: ``summarize`` one run, ``diff``
-  two runs as a CI perf gate, ``tail`` a live one.
+  (``<run_dir>/rank_<r>/events.jsonl``, rank-tagged envelope, monotonic
+  ``seq``, soft-fail writes), an in-memory ring buffer, and
+  :func:`annotate` spans that line the JSONL timeline up with
+  ``jax.profiler`` Perfetto traces.
+* :mod:`~.health` — numerical-health probes (deferred-fetch NaN/Inf +
+  norm reductions on engine applies, exchange overflow/invalid counters)
+  and the solver watchdog (``solver_health`` events; ``DMT_HEALTH=strict``
+  raises :class:`~.health.HealthError` on critical conditions).
+* ``tools/obs_report.py`` — the reader: ``summarize`` one run, ``merge`` /
+  ``report --ranks`` a multi-rank one (skew-corrected timeline, per-rank
+  straggler attribution), ``diff`` two runs as a CI perf gate, ``tail`` a
+  live one.
 
 Config: ``DMT_OBS_DIR`` (or ``obs_dir``) points the sink at a run
 directory; unset ⇒ in-memory only; ``DMT_OBS=off`` disables the layer
@@ -30,8 +37,12 @@ device-side work** (no syncs, no fetches — guard-tested).
 
 from .events import (annotate, emit, event_path, events, flush, obs_enabled,
                      reset, run_dir)
+from .health import (HealthError, drain as drain_health, health_event_count,
+                     health_mode, probes_enabled, record as record_health,
+                     reset_health)
 from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
-                      reset_metrics, series_name, snapshot)
+                      reset_metrics, series_name)
+from .metrics import snapshot as _metrics_snapshot
 
 __all__ = [
     "annotate",
@@ -50,10 +61,26 @@ __all__ = [
     "reset_metrics",
     "NULL",
     "DEFAULT_BUCKETS",
+    "HealthError",
+    "drain_health",
+    "health_event_count",
+    "health_mode",
+    "probes_enabled",
+    "record_health",
+    "reset_health",
 ]
 
 
+def snapshot() -> dict:
+    """The metrics registry as plain data — after draining any pending
+    health-probe fetches, so a closing ``metrics_snapshot`` always carries
+    the final overflow/invalid/nonfinite counter totals."""
+    drain_health()
+    return _metrics_snapshot()
+
+
 def reset_all() -> None:
-    """Reset events AND metrics (test isolation helper)."""
+    """Reset events, metrics AND health state (test isolation helper)."""
     reset()
     reset_metrics()
+    reset_health()

@@ -3,14 +3,16 @@
 One pillar of the telemetry subsystem (see ``obs/__init__``).  Every event is
 a flat JSON object with a fixed envelope::
 
-    {"seq": 17, "ts": 1754092800.123456, "proc": 0, "kind": "engine_init",
-     ...payload fields...}
+    {"seq": 17, "ts": 1754092800.123456, "proc": 0, "rank": 0, "n_ranks": 2,
+     "kind": "engine_init", ...payload fields...}
 
-``seq`` is a per-process monotonic sequence number (readers order a run by
-``(proc, seq)`` — wall clocks across hosts are not trusted), ``proc`` the JAX
-process index.  With ``DMT_OBS_DIR`` (or ``config.obs_dir``) set, each
-process appends to its OWN file ``<dir>/events.p<proc>.jsonl`` — multi-host
-safe by construction, no cross-process file locking — and every event is
+``seq`` is a per-process monotonic sequence number (readers order one rank's
+stream by ``seq`` — wall clocks across hosts are not trusted), ``rank`` the
+JAX process index and ``n_ranks`` the process count (``proc`` is kept as a
+``rank`` alias for pre-rank readers).  With ``DMT_OBS_DIR`` (or
+``config.obs_dir``) set, each process appends to its OWN file
+``<dir>/rank_<r>/events.jsonl`` — multi-host safe by construction, no
+cross-process file locking — and every event is
 also kept in a bounded in-memory ring buffer (:func:`events`) so a live
 process can inspect its own stream.  With no directory configured the layer
 still runs in-memory only (the default), and with ``DMT_OBS=off`` it is
@@ -38,7 +40,7 @@ from contextlib import nullcontext
 from typing import List, Optional
 
 from ..utils.config import get_config
-from ..utils.logging import _process_index, log_warn
+from ..utils.logging import _process_count, _process_index, log_warn
 
 __all__ = [
     "obs_enabled",
@@ -81,11 +83,13 @@ def run_dir() -> Optional[str]:
 
 
 def event_path() -> Optional[str]:
-    """This process's JSONL file path, or None when no sink is configured."""
+    """This process's JSONL file path (``<dir>/rank_<r>/events.jsonl`` — one
+    subdirectory per rank so multi-rank runs merge by construction), or None
+    when no sink is configured."""
     d = run_dir()
     if not d:
         return None
-    return os.path.join(d, f"events.p{_process_index()}.jsonl")
+    return os.path.join(d, f"rank_{_process_index()}", "events.jsonl")
 
 
 def _json_default(o):
@@ -128,17 +132,24 @@ def _write(ev: dict) -> None:
 
 def emit(kind: str, **fields) -> Optional[dict]:
     """Record one event; returns the full event dict, or None when the
-    layer is disabled.  Payload ``fields`` must not use the envelope keys
-    (``seq``/``ts``/``proc``/``kind`` — they would be overwritten)."""
+    layer is disabled.  The envelope keys (``seq``/``ts``/``proc``/
+    ``rank``/``n_ranks``/``kind``) always win: a payload field colliding
+    with one is DROPPED — readers key cross-rank ordering and straggler
+    attribution on the envelope, so a producer must never be able to
+    spoof it."""
     global _seq
     if not obs_enabled():
         return None
     with _lock:
         seq = _seq
         _seq += 1
+        rank = _process_index()
         ev = {"seq": seq, "ts": round(time.time(), 6),
-              "proc": _process_index(), "kind": str(kind)}
-        ev.update(fields)
+              "proc": rank, "rank": rank, "n_ranks": _process_count(),
+              "kind": str(kind)}
+        for k, v in fields.items():
+            if k not in ev:
+                ev[k] = v
         _buffer.append(ev)
         _write(ev)
     return ev

@@ -62,7 +62,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.operator import Operator
-from ..obs import annotate, counter, histogram
+from ..obs import annotate, counter, emit, histogram, obs_enabled
+from ..obs import health as obs_health
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
@@ -129,6 +130,13 @@ class DistributedEngine:
         self.mode = mode
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.n_devices = self.mesh.devices.size
+        # Cross-process coordination is keyed off the MESH, not the job: a
+        # rank-local mesh (every device addressable) needs no collective
+        # agreement even inside a multi-process jax.distributed job — e.g.
+        # per-rank replica engines on backends whose CPU client cannot run
+        # cross-process computations at all (the 2-process obs test rig).
+        self._multi = any(d.process_index != jax.process_index()
+                          for d in self.mesh.devices.flat)
         self.real = operator.effective_is_real
         # Complex sectors: (re, im)-f64 pair form on a TPU mesh (vectors get
         # a trailing axis of 2), native c128 elsewhere.  Both are decided by
@@ -240,6 +248,10 @@ class DistributedEngine:
         self._last_capacity: Optional[int] = None
         self._warned_traced_check = False
         self._deferred_failure: Optional[str] = None
+        self._apply_idx = 0
+        self._plan_remote_unique: Optional[int] = None
+        self._n_my_shards = sum(
+            1 for d in range(D) if self._shard_addressable(d))
 
         # Row provider for the plan builds: this process's shards come from
         # the rows already loaded above; PEER shards are fetched on demand
@@ -257,7 +269,7 @@ class DistributedEngine:
             another must rebuild — and a half-restored job would hang in
             _plan_stream's collectives.  Rebuild everywhere unless every
             rank restored."""
-            if jax.process_count() == 1:
+            if not self._multi:
                 return restored
             # ALWAYS join the collective when multi-process — a rank whose
             # cache root failed to resolve (structure_cache None) must still
@@ -321,7 +333,7 @@ class DistributedEngine:
                     operator,
                     sample_states=np.concatenate(smp) if smp
                     else np.zeros(0, np.uint64))
-                if jax.process_count() > 1:
+                if self._multi:
                     from jax.experimental import multihost_utils as mhu
                     pad = np.full(8, np.nan)
                     pad[: min(vals.size, 8)] = vals[:8]
@@ -368,7 +380,7 @@ class DistributedEngine:
                     pr[counts[d]:] = lk[0][-1]
                 pair_rows[d] = pr
                 dir_rows[d] = lk[1]
-            if jax.process_count() > 1:
+            if self._multi:
                 # probes is data-dependent per shard; the program constant
                 # must agree across processes
                 from jax.experimental import multihost_utils
@@ -379,6 +391,15 @@ class DistributedEngine:
             self._lk_dir = self._assemble_sharded(dir_rows)
             self._capacity = self._fused_capacity()
             self._matvec = self._make_fused_matvec()
+        # per-rank shard census — the survivor-count column of the
+        # cross-rank skew table (`obs_report report --ranks`): how many
+        # basis states this rank's addressable shards actually carry
+        my_shards = [d for d in range(D) if self._shard_addressable(d)]
+        emit("rank_shards", engine="distributed", mode=self.mode,
+             n_shards=int(D), shard_size=int(M), shards=my_shards,
+             states=int(sum(int(counts[d]) for d in my_shards)),
+             **({} if self._plan_remote_unique is None
+                else {"remote_entries": int(self._plan_remote_unique)}))
         emit_engine_init(self, "distributed",
                          init_s=time.perf_counter() - _t_init)
         self.timer.report()  # tree print, gated by display_timings
@@ -506,7 +527,7 @@ class DistributedEngine:
         D, M, T = self.n_devices, self.shard_size, self.num_terms
         from ..enumeration.host import shard_index as shard_index_host
 
-        multi = jax.process_count() > 1
+        multi = self._multi
         if multi:
             from jax.experimental import multihost_utils as mhu
         my_shards = [d for d in range(D) if self._shard_addressable(d)]
@@ -661,6 +682,7 @@ class DistributedEngine:
         self.query_capacity = C
         remote_unique = sum(queries[d][p].size for d in my_shards
                             for p in range(D) if queries[d][p] is not None)
+        self._plan_remote_unique = remote_unique
         log_debug(f"routing plan: D={D} M={M} T={T} T0={T0} tail={S} "
                   f"capacity={C} remote_unique(local)={remote_unique}")
 
@@ -810,7 +832,7 @@ class DistributedEngine:
                         n_all_d[M + p * C: M + p * C + qnorm[d][p].size] = \
                             qnorm[d][p]
                 n_all_shards.append(n_all_d)
-        if compact and jax.process_count() > 1:
+        if compact and self._multi:
             # badw is accumulated over THIS process's addressable shards
             # only; agree on the total before raising so a non-qualifying
             # operator fails loudly on every rank instead of hanging the
@@ -1624,9 +1646,52 @@ class DistributedEngine:
             if check or (check is None and key not in self._checked):
                 self._validate_counters(int(overflow), int(invalid), key)
                 self._checked.add(key)
-        histogram("matvec_apply_ms", engine="distributed").observe(
-            (time.perf_counter() - _t0) * 1e3)
+            # health: drain scalars parked by PREVIOUS applies (their device
+            # work has been consumed — a ready-buffer copy, not a sync),
+            # queue this apply's on-device overflow/invalid counters (fused
+            # mode computes them anyway; they ride the result transfer), and
+            # every health_every-th apply piggyback one fused NaN/Inf + norm
+            # reduction on y (a separate tiny program — the apply program is
+            # byte-identical with probes on or off)
+            obs_health.drain()
+            idx = self._apply_idx
+            self._apply_idx += 1
+            if self.mode == "fused":
+                obs_health.defer_exchange_counters("distributed", idx,
+                                                   overflow, invalid)
+            if obs_health.probe_due(idx):
+                obs_health.probe_apply("distributed", y, idx)
+        dt_ms = (time.perf_counter() - _t0) * 1e3
+        if obs_enabled():
+            # one rank-tagged event per eager apply: the raw material of
+            # the cross-rank straggler report (merge aligns these across
+            # ranks by `apply`; time-at-barrier = max − this rank's ts)
+            nbytes = self._exchange_nbytes(xh)
+            counter("exchange_bytes", engine="distributed").inc(nbytes)
+            emit("matvec_apply", engine="distributed", apply=idx,
+                 wall_ms=round(dt_ms, 4), bytes=nbytes)
+        histogram("matvec_apply_ms", engine="distributed").observe(dt_ms)
         return y
+
+    def _exchange_nbytes(self, xh) -> int:
+        """Estimated per-rank ``all_to_all`` send volume for ONE apply of
+        ``xh`` (this rank's addressable shards only).  ELL/compact send
+        exactly the padded [D, C] query payload per shard; fused mode sends
+        the fixed-capacity state+amplitude buckets per row chunk."""
+        D = self.n_devices
+        if D <= 1:
+            return 0
+        tail_elems = 1
+        for s in xh.shape[2:]:
+            tail_elems *= int(s)
+        nmy = self._n_my_shards
+        if self.mode in ("ell", "compact"):
+            return nmy * D * self.query_capacity * tail_elems * 8
+        cap = (self._last_capacity if self._last_capacity is not None
+               else getattr(self, "_capacity", 0))
+        B = self._last_program_key or self.batch_size
+        nchunks = -(-self.shard_size // max(B, 1))
+        return nmy * nchunks * D * cap * (8 + tail_elems * 8)
 
     def _validate_counters(self, overflow: int, invalid: int, key) -> None:
         """Raise loudly when the drain counters report lost amplitudes —

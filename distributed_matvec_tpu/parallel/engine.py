@@ -43,6 +43,7 @@ import numpy as np
 
 from ..models.operator import Operator
 from ..obs import annotate, counter, emit, histogram
+from ..obs import health as obs_health
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled, split_parts
@@ -658,6 +659,7 @@ class LocalEngine:
             self._checked = False
         self._warned_traced_check = False
         self._deferred_failure: Optional[str] = None
+        self._apply_idx = 0
         emit_engine_init(self, "local",
                          init_s=time.perf_counter() - _t_init)
         self.timer.report()  # tree print, gated by display_timings
@@ -1320,6 +1322,15 @@ class LocalEngine:
             if check or (check is None and not self._checked):
                 self._validate_counter(int(bad))
                 self._checked = True
+            # health probe: drain scalars parked by PREVIOUS applies (their
+            # device work has been consumed — no sync), then every
+            # health_every-th apply dispatch one fused NaN/Inf + norm
+            # reduction over y (a separate tiny program: the apply program
+            # itself is byte-identical with probes on or off)
+            obs_health.drain()
+            if obs_health.probe_due(self._apply_idx):
+                obs_health.probe_apply("local", y, self._apply_idx)
+            self._apply_idx += 1
         histogram("matvec_apply_ms", engine="local").observe(
             (time.perf_counter() - _t0) * 1e3)
         return K.complex_from_pair(np.asarray(y)) if was_complex else y

@@ -40,22 +40,88 @@ import jax.numpy as jnp
 import numpy as np
 from scipy.linalg import eigh
 
+from ..obs import health as obs_health
 from ..obs.events import emit as obs_emit, obs_enabled
 
 __all__ = ["LanczosResult", "lanczos", "lanczos_block"]
 
 
-def _emit_trace(solver: str, it: int, m: int, theta, res) -> None:
+def _emit_trace(solver: str, it: int, m: int, theta, res,
+                omega: Optional[float] = None) -> None:
     """One per-convergence-check telemetry event: the current lowest Ritz
-    values and their residual bounds — a stalled eigensolve is diagnosable
-    from the event log alone (``obs_report summarize`` turns these into
-    convergence plot data).  ``theta``/``res`` are small host arrays
-    already; no device fetch happens here."""
+    values and their residual bounds (plus the ω orthogonality-loss
+    estimate when the health layer computed one) — a stalled eigensolve is
+    diagnosable from the event log alone (``obs_report summarize`` turns
+    these into convergence plot data).  ``theta``/``res`` are small host
+    arrays already; no device fetch happens here."""
     if not obs_enabled():
         return
     obs_emit("lanczos_trace", solver=solver, iter=int(it), basis_size=int(m),
              ritz=[float(t) for t in np.atleast_1d(theta)],
-             residual=[float(r) for r in np.atleast_1d(res)])
+             residual=[float(r) for r in np.atleast_1d(res)],
+             **({} if omega is None else {"omega": float(omega)}))
+
+
+class _Watchdog:
+    """Per-solve health state: Ritz-stagnation tracking plus the ω and
+    breakdown checks, reported as ``solver_health`` events through
+    :mod:`obs.health` (warn = log only; critical = one ``[Warn]`` line,
+    or a :class:`~obs.health.HealthError` under ``DMT_HEALTH=strict``)."""
+
+    #: consecutive convergence checks without a ≥1% residual improvement
+    #: before a stagnation warning (restart plateaus are normal — one flat
+    #: check is not a stall)
+    STALL_CHECKS = 5
+
+    def __init__(self, solver: str):
+        self.solver = solver
+        self.best_res = np.inf
+        self.stalled = 0
+
+    def report_omega(self, omega: Optional[float], it: int) -> None:
+        """Threshold a precomputed ω estimate.  Called only on checks that
+        did NOT converge: a converged check's estimate still rides the
+        trace event, but a solve that just met its tolerance must not be
+        failed (strict mode) by the same tiny β that delivered it."""
+        if omega is None or not obs_health.probes_enabled():
+            return
+        if omega >= obs_health.OMEGA_CRITICAL:
+            obs_health.record("orthogonality_loss", "critical",
+                              solver=self.solver, iter=int(it),
+                              omega=float(omega))
+        elif omega >= obs_health.OMEGA_WARN:
+            obs_health.record("orthogonality_loss", "warn",
+                              solver=self.solver, iter=int(it),
+                              omega=float(omega))
+
+    def check_stagnation(self, res, it: int) -> None:
+        if not obs_health.probes_enabled():
+            return
+        cur = float(np.max(np.atleast_1d(res)))
+        if not np.isfinite(cur):
+            obs_health.record("nonfinite_residual", "critical",
+                              solver=self.solver, iter=int(it), residual=cur)
+            return
+        if cur < 0.99 * self.best_res:
+            self.best_res = cur
+            self.stalled = 0
+            return
+        self.stalled += 1
+        if self.stalled >= self.STALL_CHECKS:
+            obs_health.record("ritz_stagnation", "warn", solver=self.solver,
+                              iter=int(it), residual=cur,
+                              checks_without_progress=self.stalled)
+            self.stalled = 0
+
+    def breakdown(self, it: int, beta: float, converged: bool) -> None:
+        """β-breakdown: the Krylov space closed.  Converged closure is the
+        happy path (exact invariant subspace — no event); an UNCONVERGED
+        breakdown means the solve cannot reach the tolerance and is
+        critical."""
+        if not obs_health.probes_enabled() or converged:
+            return
+        obs_health.record("beta_breakdown", "critical", solver=self.solver,
+                          iter=int(it), beta=float(beta))
 
 # Row-block size for the blocked Gram-Schmidt sweeps: live basis rows are
 # visited in blocks of this many rows so the sweep cost scales with the
@@ -399,10 +465,13 @@ def lanczos_block(
     converged = False
     total = 0
     max_blocks = max(max_iters // p, 1)
+    a_seq: list = []        # scalarized per-step (α, β) for the ω estimate
+    b_seq: list = []
 
     first_block_s = 0.0
     first_block_iters = 0
     steady_s = 0.0
+    watchdog = _Watchdog("lanczos_block")
     obs_emit("solver_start", solver="lanczos_block", k=int(k),
              block_size=int(p), max_iters=int(max_iters), tol=float(tol))
 
@@ -432,6 +501,12 @@ def lanczos_block(
         B_list.append(np.asarray(B))
         total += p
         m = len(A_list) * p
+        # scalarized (α, β) proxy for the ω-recurrence: the block analog of
+        # β_j is the smallest new-direction magnitude min|diag(R_j)| — the
+        # quantity whose collapse signals orthogonality/rank loss — and of
+        # α_j the block's magnitude scale
+        a_seq.append(float(np.max(np.abs(A_list[-1]))))
+        b_seq.append(float(np.min(np.abs(np.diag(B_list[-1])))))
 
         # projected block-tridiagonal matrix (Hermitian by construction;
         # A is numerically Hermitian only to roundoff — symmetrize)
@@ -448,17 +523,24 @@ def lanczos_block(
         theta, S = eigh(T, subset_by_index=(0, kk - 1))
         res = np.linalg.norm(
             np.asarray(B_list[-1]) @ S[m - p:, :], axis=0)
-        _emit_trace("lanczos_block", total, m, theta, res)
+        omega = obs_health.omega_estimate(
+            np.asarray(a_seq), np.asarray(b_seq),
+            len(b_seq) - 1, len(b_seq)) \
+            if obs_health.probes_enabled() else None
+        _emit_trace("lanczos_block", total, m, theta, res, omega)
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
+        watchdog.report_omega(omega, total)
         # breakdown: the Krylov space closed (rank-deficient new block) —
         # with full reorth a deficient column is numerical noise, stop
         rdiag = np.abs(np.diag(np.asarray(B)))
         if rdiag.min() < 1e-12 * max(rdiag.max(), 1.0):
+            watchdog.breakdown(total, float(rdiag.min()), converged=False)
             break
         if total + p > max_iters:
             break
+        watchdog.check_stagnation(res, total)
         blocks.append(Qn)
 
     kk = min(k, len(A_list) * p)
@@ -683,6 +765,7 @@ def lanczos(
     first_block_s = 0.0
     first_block_iters = 0
     steady_s = 0.0
+    watchdog = _Watchdog("lanczos")
     obs_emit("solver_start", solver="lanczos", k=int(k),
              max_iters=int(max_iters), tol=float(tol), pair=bool(pair),
              max_basis_size=int(mcap), resumed_from=int(resumed_from))
@@ -734,12 +817,19 @@ def lanczos(
         T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)
         theta, S = eigh(T, subset_by_index=(0, kk - 1))
         res = np.abs(bet[m - 1] * S[m - 1, :])
-        _emit_trace("lanczos", total_iters, m, theta, res)
+        omega = obs_health.omega_estimate(alph, bet, max(lo, m - nsteps), m) \
+            if obs_health.probes_enabled() else None
+        _emit_trace("lanczos", total_iters, m, theta, res, omega)
         if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
             converged = True
             break
+        watchdog.report_omega(omega, total_iters)
         if broke is not None:
-            break   # Krylov space closed without meeting the tolerance
+            # Krylov space closed without meeting the tolerance
+            watchdog.breakdown(total_iters, float(bet[broke]),
+                               converged=False)
+            break
+        watchdog.check_stagnation(res, total_iters)
 
         blocks_done += 1
         if checkpoint_path and blocks_done % max(checkpoint_every, 1) == 0:

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import health as obs_health
 from ..obs.events import emit as obs_emit
 
 __all__ = ["lobpcg"]
@@ -42,9 +43,18 @@ __all__ = ["lobpcg"]
 def _emit_end(iters: int, evals) -> None:
     """Final telemetry event (lobpcg_standard's jitted while_loop exposes no
     per-iteration host callback, so unlike Lanczos the trace granularity
-    here is the solve, not the step)."""
+    here is the solve, not the step — and the health check likewise runs on
+    the finished spectrum: a NaN/Inf eigenvalue is the one silent-decay
+    signature visible at this granularity)."""
+    vals = [float(v) for v in np.atleast_1d(evals)]
     obs_emit("solver_end", solver="lobpcg", iters=int(iters),
-             eigenvalues=[float(v) for v in np.atleast_1d(evals)])
+             eigenvalues=vals)
+    if vals and not np.all(np.isfinite(vals)) \
+            and obs_health.probes_enabled():
+        obs_health.record(
+            "nonfinite_eigenvalues", "critical", solver="lobpcg",
+            iters=int(iters),
+            count=int(np.sum(~np.isfinite(np.asarray(vals)))))
 
 
 def _norm_estimate(matvec: Callable, n: int, iters: int = 20, seed: int = 3):

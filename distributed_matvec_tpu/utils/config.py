@@ -31,7 +31,15 @@ class RuntimeConfig:
     #   hot paths add zero device-side work
     obs_dir: str = ""                      # event-sink run directory
     #   (DMT_OBS_DIR): non-empty → append-only JSONL stream per process at
-    #   <obs_dir>/events.p<process_index>.jsonl; empty → in-memory only
+    #   <obs_dir>/rank_<r>/events.jsonl; empty → in-memory only
+    health: str = "on"                     # numerical-health watchdog
+    #   (DMT_HEALTH): "on" emits `health`/`solver_health` events and logs
+    #   critical conditions but continues; "strict" raises HealthError on
+    #   critical; "off" disables the probes entirely (obs off implies off)
+    health_every: int = 16                 # engine-apply probe cadence
+    #   (DMT_HEALTH_EVERY): every Nth eager apply piggybacks one fused
+    #   NaN/Inf-count + output-norm reduction on the result; the scalar is
+    #   fetched DEFERRED so no sync is added to the hot path
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

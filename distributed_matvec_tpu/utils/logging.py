@@ -23,6 +23,7 @@ _START = time.time()
 # i.e. a successful query freezes the process topology, so the cached value
 # can never silently go stale (verified against this jaxlib).
 _proc_idx: Optional[int] = None
+_proc_count: Optional[int] = None
 
 
 def _process_index() -> int:
@@ -36,6 +37,22 @@ def _process_index() -> int:
         return _proc_idx
     except Exception:
         return 0
+
+
+def _process_count() -> int:
+    """Total process (rank) count, cached on the same freeze-on-success
+    contract as :func:`_process_index` (a successful backend query pins the
+    process topology for the life of the process)."""
+    global _proc_count
+    if _proc_count is not None:
+        return _proc_count
+    try:
+        import jax
+
+        _proc_count = int(jax.process_count())
+        return _proc_count
+    except Exception:
+        return 1
 
 
 def log_debug(*parts) -> None:

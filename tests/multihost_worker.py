@@ -52,6 +52,42 @@ op = operator_from_dict({"terms": [{
 x = np.random.default_rng(7).standard_normal(basis.number_states)
 want = op.matvec_host(x)
 
+
+def _finish_obs():
+    """Close this rank's telemetry stream: final registry totals (drains
+    any pending health-probe fetches) + flush, so the run directory is
+    complete for ``obs_report merge``/``report`` the moment we exit."""
+    from distributed_matvec_tpu import obs
+
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
+
+
+if os.environ.get("DMT_MH_FAST"):
+    # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
+    # per rank over a RANK-LOCAL mesh (all engine collectives stay
+    # intra-process, so the leg also runs on CPU backends whose client
+    # cannot execute cross-process computations — the telemetry is still
+    # rank-tagged by the real 2-process jax.distributed job), a handful of
+    # eager applies (each emits a rank-tagged matvec_apply event — the raw
+    # material of the straggler report), then the closing snapshot.
+    # Correctness still asserted so a broken exchange cannot masquerade as
+    # a telemetry pass.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="ell")
+    xh = eng.to_hashed(x)
+    for _ in range(4):
+        yh = eng.matvec(xh)
+    y = eng.from_hashed(yh)
+    err = float(np.abs(y - want).max())
+    print(f"[p{pid}] fast ell: matvec max err {err:.3e}", flush=True)
+    assert err < 1e-12, err
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 for mode in ("ell", "compact", "fused"):
     eng = DistributedEngine(op, n_devices=4 * nproc, mode=mode)
     y = eng.from_hashed(eng.matvec(eng.to_hashed(x)))
@@ -144,4 +180,5 @@ if shards_path:
     print(f"[p{pid}] from_shards resumed E0/4 = {e0s / 4:.10f}", flush=True)
     assert abs(e0s / 4 - E0_OVER_4) < 1e-7
 
+_finish_obs()
 print(f"[p{pid}] MULTIHOST_OK", flush=True)

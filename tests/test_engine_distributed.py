@@ -471,6 +471,39 @@ def test_multihost_two_process(tmp_path):
 
 
 @needs_8
+def test_fused_exchange_counters_reach_obs(rng):
+    """Satellite: the overflow/invalid counters the fused apply computes
+    on-device are no longer dropped on the non-debug path — after the
+    deferred drain they are visible (at zero, the healthy reading) as obs
+    counters, alongside the per-apply rank-tagged matvec_apply events."""
+    from distributed_matvec_tpu import obs
+
+    obs.reset_all()
+    try:
+        op = build_heisenberg(10, 5)
+        op.basis.build()
+        x = rng.random(op.basis.number_states) - 0.5
+        eng = DistributedEngine(op, n_devices=8, mode="fused")
+        xh = eng.to_hashed(x)
+        eng.matvec(xh)
+        eng.matvec(xh)
+        snap = obs.snapshot()                  # drains pending fetches
+        c = snap["counters"]
+        assert c.get("exchange_overflow{engine=distributed}") == 0
+        assert c.get("exchange_invalid{engine=distributed}") == 0
+        assert c.get("exchange_bytes{engine=distributed}", 0) > 0
+        applies = obs.events("matvec_apply")
+        assert len(applies) == 2
+        assert all(ev["engine"] == "distributed" and ev["bytes"] > 0
+                   and ev["rank"] == 0 for ev in applies)
+        assert [ev["apply"] for ev in applies] == [0, 1]
+        shards = obs.events("rank_shards")
+        assert shards and shards[-1]["states"] == op.basis.number_states
+    finally:
+        obs.reset_all()
+
+
+@needs_8
 @pytest.mark.parametrize("mode", ["ell", "compact"])
 def test_distributed_scan_branch(mode, rng, monkeypatch):
     """The lax.scan fallback of the term loops (taken only at LARGE T0,

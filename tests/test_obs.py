@@ -176,6 +176,8 @@ def test_emit_envelope_and_buffer(clean_obs):
     e1 = obs.emit("beta", y=[1, 2])
     assert (e0["seq"], e1["seq"]) == (0, 1)        # monotonic per process
     assert e0["kind"] == "alpha" and e0["proc"] == 0 and e0["ts"] > 0
+    # rank-tagged envelope: rank mirrors proc, n_ranks the process count
+    assert e0["rank"] == 0 and e0["n_ranks"] == 1
     assert [e["kind"] for e in obs.events()] == ["alpha", "beta"]
     assert obs.events("beta") == [e1]
 
@@ -188,7 +190,7 @@ def test_jsonl_round_trip(clean_obs, tmp_path, monkeypatch):
     obs.emit("two", nested={"a": [1.5, 2.5]})
     obs.flush()
     path = obs.event_path()
-    assert path == str(run / "events.p0.jsonl")
+    assert path == str(run / "rank_0" / "events.jsonl")
     lines = [json.loads(ln) for ln in
              open(path).read().strip().splitlines()]
     assert [e["kind"] for e in lines] == ["one", "two"]
@@ -366,12 +368,16 @@ def test_obs_report_summarize_run_dir(clean_obs, tmp_path, monkeypatch):
         "aot_executable_cache{event=hit}": 7,
         "aot_executable_cache{event=compile}": 1,
         "bytes_h2d{path=engine_tables}": 1024,
+        "exchange_overflow{engine=distributed}": 0,
+        "exchange_invalid{engine=distributed}": 2,
         "retrace_count": 1}})
     obs.flush()
     obs.reset()
-    # a second process's stream must merge in (proc, seq) order
-    (run / "events.p1.jsonl").write_text(json.dumps(
-        {"seq": 0, "ts": 0.0, "proc": 1, "kind": "engine_init",
+    # a second rank's stream must merge in (rank, seq) order
+    (run / "rank_1").mkdir()
+    (run / "rank_1" / "events.jsonl").write_text(json.dumps(
+        {"seq": 0, "ts": 0.0, "proc": 1, "rank": 1, "n_ranks": 2,
+         "kind": "engine_init",
          "engine": "distributed", "mode": "ell", "n_states": 100,
          "basis_restored": False, "structure_restored": True,
          "build_structure_s": 0.0, "compile_s": 0.0, "kernels_s": 0.0,
@@ -388,6 +394,11 @@ def test_obs_report_summarize_run_dir(clean_obs, tmp_path, monkeypatch):
     assert caches["aot_executable_cache"]["hit_rate"] == pytest.approx(7 / 8)
     assert s["cache"]["bytes_h2d"] == 1024
     assert s["cache"]["retrace_count"] == 1
+    # the overflow/invalid exchange counters are surfaced even at zero
+    assert s["health"]["counters"][
+        "exchange_overflow{engine=distributed}"] == 0
+    assert s["health"]["counters"][
+        "exchange_invalid{engine=distributed}"] == 2
     sv = s["solvers"][0]
     assert sv["converged"] is True
     assert [t["iter"] for t in sv["trace"]] == [16, 32]
@@ -404,6 +415,396 @@ def test_obs_report_load_events_jsonl_and_torn_line(tmp_path, capsys):
                  + '{"seq": 1, "proc": 0, "ki')       # torn final line
     evs = rep.load_events(str(f))
     assert [e["kind"] for e in evs] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# numerical-health probes + solver watchdog
+
+
+@pytest.fixture
+def health_every_1():
+    """Probe cadence 1 (every apply), restored afterwards."""
+    from distributed_matvec_tpu.utils.config import get_config, update_config
+    saved = get_config().health_every
+    update_config(health_every=1)
+    yield
+    update_config(health_every=saved)
+
+
+def test_health_probe_nan_event_and_strict(clean_obs, rng, monkeypatch,
+                                           health_every_1):
+    """A NaN injected into the input fires a `health` event with the
+    correct rank + nonfinite count; DMT_HEALTH=strict turns it into a
+    HealthError raised from the apply itself."""
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    op = build_heisenberg(10, 5, None, ())
+    eng = LocalEngine(op, mode="ell")
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    x[3] = np.nan
+    eng.matvec(x)
+    obs.drain_health()
+    evs = obs.events("health")
+    assert evs, "no health event for a NaN-carrying apply"
+    ev = evs[-1]
+    assert ev["check"] == "nonfinite_output" and ev["level"] == "critical"
+    assert ev["rank"] == 0 and ev["engine"] == "local"
+    assert ev["count"] >= 1                      # NaN propagated to outputs
+    snap = obs.snapshot()
+    assert snap["counters"]["matvec_nonfinite{engine=local}"] >= 1
+    assert snap["counters"]["health_events{level=critical}"] >= 1
+
+    monkeypatch.setenv("DMT_HEALTH", "strict")
+    with pytest.raises(obs.HealthError, match="nonfinite_output"):
+        eng.matvec(x)
+
+
+def test_health_probe_disabled_compiled_out(clean_obs, rng, monkeypatch):
+    """DMT_OBS=off guard (the PR-2 pattern extended to the probes): no
+    probe program is ever dispatched, results stay bit-identical, AND the
+    apply program itself carries no probe ops in ANY mode — the probe is a
+    separate piggyback program, so toggling it can neither change nor
+    retrace the hot program."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_matvec_tpu.obs import health as H
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    op = build_heisenberg(10, 5, None, ())
+    eng = LocalEngine(op, mode="ell")
+    n = op.basis.number_states
+    x = rng.random(n) - 0.5
+    y_on = np.asarray(eng.matvec(x))
+
+    monkeypatch.setenv("DMT_OBS", "off")
+    obs.reset_all()
+
+    def _explode(*a, **k):
+        raise AssertionError("health probe dispatched while obs disabled")
+
+    monkeypatch.setattr(H, "_stats", _explode)
+    assert not obs.probes_enabled()
+    y_off = np.asarray(eng.matvec(x))
+    np.testing.assert_array_equal(y_on, y_off)
+    assert obs.events() == []
+    hlo = jax.jit(eng._apply_fn).lower(
+        jnp.asarray(x), eng._operands).compile().as_text()
+    assert "is-finite" not in hlo.lower()
+
+
+def test_omega_estimate_thresholds(clean_obs):
+    """Healthy recurrence → ω ~ ε (quiet); a collapsing β explodes the
+    estimate past the warn/critical thresholds."""
+    from distributed_matvec_tpu.obs import health as H
+    rng_ = np.random.default_rng(0)
+    alph = rng_.normal(0.0, 1.0, 64)
+    bet = np.abs(rng_.normal(1.0, 0.1, 64)) + 0.5
+    assert H.omega_estimate(alph, bet, 0, 64) < H.OMEGA_WARN
+
+    bet_bad = bet.copy()
+    bet_bad[40] = 1e-13                          # near-breakdown step
+    om = H.omega_estimate(alph, bet_bad, 0, 64)
+    assert om >= H.OMEGA_CRITICAL
+
+
+def test_solver_watchdog_events_and_strict(clean_obs, monkeypatch):
+    from distributed_matvec_tpu.solve.lanczos import _Watchdog
+    wd = _Watchdog("lanczos")
+    # converged closure is the happy path: no event
+    wd.breakdown(10, 1e-16, converged=True)
+    assert obs.events("solver_health") == []
+    wd.breakdown(10, 1e-16, converged=False)
+    ev = obs.events("solver_health")[-1]
+    assert ev["check"] == "beta_breakdown" and ev["level"] == "critical"
+    assert ev["solver"] == "lanczos" and ev["rank"] == 0
+
+    # stagnation: warn only after STALL_CHECKS flat convergence checks
+    wd2 = _Watchdog("lanczos")
+    for _ in range(_Watchdog.STALL_CHECKS + 1):
+        wd2.check_stagnation(np.array([1e-3]), 1)
+    stalls = [e for e in obs.events("solver_health")
+              if e["check"] == "ritz_stagnation"]
+    assert stalls and stalls[-1]["level"] == "warn"
+
+    monkeypatch.setenv("DMT_HEALTH", "strict")
+    with pytest.raises(obs.HealthError, match="beta_breakdown"):
+        wd.breakdown(11, 1e-16, converged=False)
+
+
+def test_lanczos_trace_carries_omega(clean_obs, rng):
+    """The per-check lanczos_trace events gain the ω estimate, and a
+    healthy converging solve emits zero solver_health events."""
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    from distributed_matvec_tpu.solve import lanczos
+    op = build_heisenberg(10, 5, None, ())
+    eng = LocalEngine(op, mode="ell")
+    res = lanczos(eng.matvec, op.basis.number_states, k=1, max_iters=48,
+                  tol=1e-10, seed=3)
+    assert res.converged
+    traces = obs.events("lanczos_trace")
+    assert traces and "omega" in traces[-1]
+    assert traces[-1]["omega"] < 1e-8            # healthy: ~eps
+    assert obs.events("solver_health") == []
+    assert obs.events("health") == []
+    # the block solver carries the (scalarized) omega estimate too
+    from distributed_matvec_tpu.solve import lanczos_block
+    lanczos_block(eng.matvec, op.basis.number_states, k=1, max_iters=24,
+                  tol=1e-8, seed=3)
+    blk = [e for e in obs.events("lanczos_trace")
+           if e["solver"] == "lanczos_block"]
+    assert len(blk) >= 2 and "omega" in blk[-1]
+    assert blk[-1]["omega"] < 1e-8
+
+
+# ---------------------------------------------------------------------------
+# cross-rank merge / skew / straggler report
+
+
+def _write_rank_events(run, rank, events):
+    d = run / f"rank_{rank}"
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "events.jsonl", "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _toy_two_rank_run(run, skew=5.0, n_apply=6, late_apply=3,
+                      late_s=0.040):
+    """A deliberately imbalanced 2-rank toy run: rank 1's clock runs
+    ``skew`` seconds ahead and its apply ``late_apply`` arrives
+    ``late_s`` late — the straggler the report must attribute."""
+    t0 = 1000.0
+    for r, off in ((0, 0.0), (1, skew)):
+        evs = []
+
+        def ev(kind, ts, **fields):
+            e = {"seq": len(evs), "ts": round(ts + off, 6), "proc": r,
+                 "rank": r, "n_ranks": 2, "kind": kind}
+            e.update(fields)
+            evs.append(e)
+
+        ev("rank_shards", t0, engine="distributed", mode="ell",
+           n_shards=8, shard_size=128,
+           shards=[0, 1, 2, 3] if r == 0 else [4, 5, 6, 7],
+           states=460 if r == 0 else 464)
+        ev("engine_init", t0 + 1.0, engine="distributed", mode="ell",
+           n_states=924, basis_restored=False, structure_restored=False,
+           build_structure_s=0.8 if r == 0 else 0.9, compile_s=0.1,
+           kernels_s=0.1, transfer_s=0.05, diag_s=0.01, init_s=1.2)
+        for i in range(n_apply):
+            late = late_s if (r == 1 and i == late_apply) else 0.0
+            ev("matvec_apply", t0 + 2.0 + 0.1 * i + late,
+               engine="distributed", apply=i, wall_ms=2.0, bytes=100_000)
+        ev("metrics_snapshot", t0 + 3.0, metrics={
+            "counters": {"exchange_bytes{engine=distributed}": 600_000},
+            "gauges": {},
+            "histograms": {"double_buffer_stall_ms": {
+                "buckets": [1.0], "counts": [3, 0],
+                "sum": 1.5, "count": 3}}})
+        _write_rank_events(run, r, evs)
+
+
+def test_obs_report_merge_and_straggler(tmp_path):
+    rep = _load_obs_report()
+    run = tmp_path / "run"
+    _toy_two_rank_run(run, skew=5.0, n_apply=6, late_apply=3)
+    events = rep.load_events(str(run))
+    assert sorted({e["rank"] for e in events}) == [0, 1]
+
+    # the median-based skew estimate recovers the 5 s clock offset without
+    # being polluted by the straggling apply
+    offsets = rep.estimate_skew(events)
+    assert offsets[0] == 0.0
+    assert abs(offsets[1] - 5.0) < 5e-3
+
+    merged, _ = rep.merge_events(events)
+    adj = [e["ts_adj"] for e in merged]
+    assert adj == sorted(adj)                    # ONE ordered timeline
+    for r in (0, 1):                             # per-rank seq order kept
+        seqs = [e["seq"] for e in merged if e["rank"] == r]
+        assert seqs == sorted(seqs)
+    # after correction the two ranks interleave (uncorrected, all of rank
+    # 0 would precede all of rank 1 by 5 s)
+    order = [e["rank"] for e in merged]
+    assert order != sorted(order)
+
+    table = rep.rank_table(events)
+    rows = {row["rank"]: row for row in table["rows"]}
+    assert rows[0]["states"] == 460 and rows[1]["states"] == 464
+    per_bytes = [rows[r]["bytes_exchanged"] for r in (0, 1)]
+    mean_b = sum(per_bytes) / 2
+    assert all(abs(b - mean_b) <= 0.12 * mean_b for b in per_bytes)
+    assert rows[0]["plan_wall_s"] == pytest.approx(0.8)
+    assert rows[1]["db_stall_ms"] == pytest.approx(1.5)
+
+    st = table["straggler"]
+    assert st["applies"] == 6
+    # the deliberate straggler is attributed to rank 1, apply 3, with
+    # excess = max - median = late/2 for two ranks
+    assert st["worst"][0]["rank"] == 1 and st["worst"][0]["apply"] == 3
+    assert st["worst"][0]["excess_ms"] == pytest.approx(20.0, rel=0.1)
+    assert st["per_rank"][1]["straggled"] >= 1
+    # rank 0 sat at the barrier for the late apply
+    assert st["per_rank"][0]["barrier_wait_ms"] > 0
+    rep.print_rank_report(table, show_ranks=True)   # renderer must not throw
+
+
+def test_obs_report_legacy_and_mixed_layouts(tmp_path, capsys):
+    """Legacy flat events.p*.jsonl dirs still load; a dir holding BOTH a
+    legacy and a rank_*/ run (reused DMT_OBS_DIR across the upgrade) reads
+    only the current layout and warns instead of interleaving two runs'
+    duplicate seq numbers into one corrupt timeline."""
+    rep = _load_obs_report()
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "events.p0.jsonl").write_text(json.dumps(
+        {"seq": 0, "proc": 0, "kind": "old"}) + "\n")
+    assert [e["kind"] for e in rep.load_events(str(run))] == ["old"]
+    (run / "rank_0").mkdir()
+    (run / "rank_0" / "events.jsonl").write_text(json.dumps(
+        {"seq": 0, "rank": 0, "n_ranks": 1, "kind": "new"}) + "\n")
+    evs = rep.load_events(str(run))
+    assert [e["kind"] for e in evs] == ["new"]
+    assert "ignoring 1 legacy" in capsys.readouterr().err
+
+
+def test_obs_report_replica_run_flagged_non_collective(tmp_path):
+    """Rank-local replica engines (overlapping shard ids across ranks) are
+    flagged so barrier columns read as progress skew, not barrier waits."""
+    rep = _load_obs_report()
+    run = tmp_path / "run"
+    for r in (0, 1):
+        _write_rank_events(run, r, [
+            {"seq": 0, "ts": 1000.0, "rank": r, "n_ranks": 2,
+             "kind": "rank_shards", "engine": "distributed", "mode": "ell",
+             "n_shards": 4, "shard_size": 64,
+             "shards": [0, 1, 2, 3], "states": 924}])
+    table = rep.rank_table(rep.load_events(str(run)))
+    assert table["collective"] is False
+    rep.print_rank_report(table, show_ranks=True)
+
+
+def test_obs_report_summarize_tolerates_rank_layout(clean_obs, tmp_path,
+                                                    monkeypatch):
+    """summarize over the rank-subdirectory layout the sink now writes."""
+    rep = _load_obs_report()
+    run = tmp_path / "run"
+    monkeypatch.setenv("DMT_OBS_DIR", str(run))
+    obs.emit("bench_result", config="c16", device_ms=1.5)
+    obs.flush()
+    obs.reset()
+    assert (run / "rank_0" / "events.jsonl").exists()
+    s = rep.run_summary(rep.load_events(str(run)))
+    assert s["bench"]["c16"]["device_ms"] == 1.5
+    rep.print_summary(s)
+
+
+def test_follow_poll_rotation(tmp_path):
+    """tail --follow survives rotation (new inode), in-place truncation,
+    and truncation that regrew past the old offset between polls, without
+    losing the recreated file's events."""
+    rep = _load_obs_report()
+    f = tmp_path / "events.jsonl"
+    f.write_text(json.dumps({"seq": 0, "kind": "a"}) + "\n")
+    fs = str(f)
+    state = {fs: (rep._stat_id(fs), f.stat().st_size, rep._head_bytes(fs))}
+    partial = {}
+    with open(f, "a") as fh:                     # plain append
+        fh.write(json.dumps({"seq": 1, "kind": "b"}) + "\n")
+    assert [e["kind"] for e in rep._follow_poll([fs], state, partial)] \
+        == ["b"]
+    os.remove(f)                                 # rotation: new inode
+    f.write_text(json.dumps({"seq": 0, "kind": "c"}) + "\n")
+    assert [e["kind"] for e in rep._follow_poll([fs], state, partial)] \
+        == ["c"]
+    f.write_text("")                             # truncation in place
+    assert rep._follow_poll([fs], state, partial) == []
+    with open(f, "a") as fh:
+        fh.write(json.dumps({"seq": 0, "kind": "d"}) + "\n")
+    assert [e["kind"] for e in rep._follow_poll([fs], state, partial)] \
+        == ["d"]
+    # truncated AND regrown past the old offset before the next poll
+    # (same inode, larger size — only the head fingerprint catches it)
+    f.write_text(json.dumps({"seq": 0, "kind": "e", "pad": "x" * 64}) + "\n"
+                 + json.dumps({"seq": 1, "kind": "f"}) + "\n")
+    assert [e["kind"] for e in rep._follow_poll([fs], state, partial)] \
+        == ["e", "f"]
+
+
+def test_multihost_obs_rank_merge(tmp_path):
+    """A REAL 2-process run (multihost worker harness, fast leg): rank-
+    tagged events land under rank_0/ and rank_1/, merge produces one
+    ordered timeline, and the skew table reports per-rank survivor states
+    and bytes within the enumeration's ±12% balance bound."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    rep = _load_obs_report()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    run = tmp_path / "obs_run"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_FAST"] = "1"
+    env["DMT_OBS_DIR"] = str(run)
+    procs = [subprocess.Popen(
+        [_sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
+
+    assert (run / "rank_0" / "events.jsonl").exists()
+    assert (run / "rank_1" / "events.jsonl").exists()
+    events = rep.load_events(str(run))
+    ranks = sorted({e["rank"] for e in events})
+    assert ranks == [0, 1]
+    assert all(e.get("n_ranks") == 2 for e in events)
+
+    merged, offsets = rep.merge_events(events)
+    assert set(offsets) == {0, 1}
+    adj = [e["ts_adj"] for e in merged]
+    assert adj == sorted(adj)                    # one ordered timeline
+    for r in ranks:
+        seqs = [e["seq"] for e in merged if e["rank"] == r]
+        assert seqs == sorted(seqs)
+
+    table = rep.rank_table(events)
+    rows = {row["rank"]: row for row in table["rows"]}
+    # This leg runs identical rank-local REPLICA engines (the CPU backend
+    # cannot execute cross-process programs), so states/bytes are equal
+    # across ranks by construction: these are stream-integrity checks —
+    # every rank's census and per-apply bytes survived the merge within
+    # the ±12% bound.  The bound's DISCRIMINATING test (unequal ranks,
+    # deliberate straggler) is test_obs_report_merge_and_straggler.
+    states = [rows[r]["states"] for r in ranks]
+    mean_s = sum(states) / 2
+    assert all(s and abs(s - mean_s) <= 0.12 * mean_s for s in states), \
+        states
+    per_bytes = [rows[r]["bytes_exchanged"] for r in ranks]
+    mean_b = sum(per_bytes) / 2
+    assert all(b > 0 and abs(b - mean_b) <= 0.12 * mean_b
+               for b in per_bytes), per_bytes
+    assert table["collective"] is False          # replicas, flagged as such
+    n_apply = rows[0]["applies"]
+    assert n_apply >= 4
+    assert all(rows[r]["applies"] == n_apply for r in ranks)
+    assert all(rows[r]["plan_wall_s"] is not None for r in ranks)
+    assert table["straggler"]["applies"] >= 4
+    rep.print_rank_report(table, show_ranks=True)
 
 
 # ---------------------------------------------------------------------------

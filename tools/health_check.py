@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""health_check — the ``make health-check`` gate for the numerical-health
+probes (obs/health.py).
+
+Two assertions over the chain-16 smoke config:
+
+1. **Overhead**: probe-on applies cost < ``--threshold`` (default 2%) more
+   than probe-off applies on ``device_ms``.  Both sides are timed in ONE
+   process with the SAME warm engine, interleaved per attempt — two
+   separate bench processes would compare cold caches and scheduler noise
+   instead of probe cost.  Wall-clock on a shared host is still noisy, so
+   the gate retries: a spurious spike passes on a later attempt, a genuine
+   regression fails all of them.
+2. **Cleanliness**: a probes-on Lanczos solve of the same config emits
+   ZERO ``health``/``solver_health`` events — the watchdog thresholds must
+   stay quiet on a healthy run, or every real alert drowns.
+
+Prints one JSON line and exits 0/1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time_applies(eng, xj, repeats: int) -> float:
+    import jax
+
+    for _ in range(5):                  # re-warm: caches, queue, scheduler
+        y = eng.matvec(xj)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        y = eng.matvec(xj)
+    jax.block_until_ready(y)
+    return (time.perf_counter() - t0) / repeats * 1e3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--threshold", type=float, default=0.02,
+                    help="max relative probe overhead on device_ms "
+                         "(default 0.02)")
+    ap.add_argument("--repeats", type=int, default=100,
+                    help="applies per timing side per attempt")
+    ap.add_argument("--attempts", type=int, default=5,
+                    help="retries before a regression is believed")
+    args = ap.parse_args(argv)
+
+    # The gate must own its knobs: health_mode()/obs_enabled() give these
+    # env vars precedence over the update_config() toggles below, so an
+    # inherited DMT_HEALTH=off would make both timing sides unprobed (a
+    # vacuous pass) and DMT_OBS=off would disable the layer under test.
+    for knob in ("DMT_HEALTH", "DMT_HEALTH_EVERY", "DMT_OBS", "DMT_OBS_DIR"):
+        os.environ.pop(knob, None)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.obs import health as H
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+    from distributed_matvec_tpu.solve import lanczos
+    from distributed_matvec_tpu.utils.config import get_config, update_config
+
+    basis = SpinBasis(number_spins=16, hamming_weight=8)
+    basis.build()
+    op = heisenberg_from_edges(basis, chain_edges(16))
+    eng = LocalEngine(op, mode="ell")
+    n = basis.number_states
+    x = np.random.default_rng(0).standard_normal(n)
+    xj = jax.numpy.asarray(x / np.linalg.norm(x))
+
+    saved = (get_config().health, get_config().health_every)
+    result = {"config": "heisenberg_chain_16", "n_states": n,
+              "threshold": args.threshold}
+    try:
+        # warm: apply program, first-apply validation, AND the probe
+        # reduction (its one-time compile must not land in the timing)
+        update_config(health="on")
+        y = eng.matvec(xj)
+        jax.block_until_ready(y)
+        H._stats(y)
+        H.reset_health()
+
+        overhead = None
+        for attempt in range(1, args.attempts + 1):
+            update_config(health="off")
+            off_ms = _time_applies(eng, xj, args.repeats)
+            update_config(health="on")
+            on_ms = _time_applies(eng, xj, args.repeats)
+            H.drain()
+            overhead = on_ms / off_ms - 1.0
+            result.update(device_ms_probes_off=round(off_ms, 4),
+                          device_ms_probes_on=round(on_ms, 4),
+                          probe_overhead=round(overhead, 4),
+                          attempts=attempt)
+            if overhead < args.threshold:
+                break
+            print(f"[health_check] attempt {attempt}: overhead "
+                  f"{overhead:+.2%} over {args.threshold:.0%} gate; "
+                  "retrying (timing noise vs genuine cost)",
+                  file=sys.stderr)
+        ok_overhead = overhead is not None and overhead < args.threshold
+
+        # cleanliness: probes on, watchdog on — a healthy solve must stay
+        # silent (counts BOTH probe events and solver watchdog events)
+        update_config(health="on")
+        before = obs.health_event_count()
+        res = lanczos(eng.matvec, n, k=1, max_iters=80, tol=1e-10, seed=3)
+        warnings = obs.health_event_count() - before
+        result.update(health_events=warnings,
+                      lanczos_converged=bool(res.converged))
+        ok_clean = warnings == 0 and res.converged
+    finally:
+        update_config(health=saved[0], health_every=saved[1])
+
+    result["ok"] = bool(ok_overhead and ok_clean)
+    print(json.dumps(result))
+    if not ok_overhead:
+        print(f"[health_check] FAIL: probe overhead "
+              f"{result.get('probe_overhead')} >= {args.threshold} "
+              f"after {args.attempts} attempts", file=sys.stderr)
+    if not ok_clean:
+        print(f"[health_check] FAIL: {warnings} health event(s) on a "
+              "healthy chain-16 solve (expected zero)", file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

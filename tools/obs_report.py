@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""obs_report — read, summarize, diff, and tail telemetry runs.
+"""obs_report — read, summarize, merge, diff, and tail telemetry runs.
 
 The reader side of the ``distributed_matvec_tpu/obs`` subsystem.  A *run* is
 either
 
 * a run directory written under ``DMT_OBS_DIR`` (one
-  ``events.p<proc>.jsonl`` per process, ordered by ``(proc, seq)``),
+  ``rank_<r>/events.jsonl`` per process — the pre-rank
+  ``events.p<proc>.jsonl`` layout is still read),
 * a single ``.jsonl`` event file, or
 * a bench detail JSON (``BENCH_DETAIL.json`` — ``{config_key: {metrics}}``),
   which is treated as a run containing only ``bench_result`` events so the
@@ -16,9 +17,26 @@ Subcommands::
     summarize RUN [--json]
         One run → engine-init split table (structure/compile/transfer/diag),
         artifact-cache hit rates + AOT executable-cache reuse + transfer
-        volume from the final metrics snapshot, per-config bench metrics,
-        and solver convergence traces (iteration → Ritz value/residual —
-        ready-to-plot data).
+        volume from the final metrics snapshot, numerical-health counters
+        (exchange overflow/invalid, nonfinite outputs) + events, per-config
+        bench metrics, and solver convergence traces (iteration → Ritz
+        value/residual — ready-to-plot data).
+
+    merge RUN [-o OUT.jsonl]
+        Multi-rank run → ONE ordered timeline.  Per-rank wall-clock skew is
+        estimated from events that follow cross-rank synchronization points
+        (engine inits, the i-th eager apply — SPMD runs execute the same
+        program order on every rank), each event gains a skew-corrected
+        ``ts_adj``, and the merged stream is ordered by
+        ``(ts_adj, rank, seq)`` (within-rank ``seq`` order is monotonic and
+        trusted; wall clocks across hosts are not).
+
+    report RUN [--ranks] [--json]
+        Cross-rank skew report: estimated clock offsets, straggler
+        attribution per apply (the rank whose aligned ``matvec_apply``
+        lands last; excess = max − median), and with ``--ranks`` the
+        per-rank table — events, survivor states, bytes exchanged,
+        plan-build wall, double-buffer stalls, mean time-at-barrier.
 
     diff BASELINE NEW [--threshold 0.2] [--metric device_ms ...]
                       [--config NAME ...] [--all-metrics]
@@ -31,7 +49,8 @@ Subcommands::
 
     tail RUN [-n 20] [--follow]
         Human-readable view of the last events; ``--follow`` keeps reading
-        as a live run appends.
+        as a live run appends (rotated/recreated files are reopened on
+        inode change, so a restarted writer never silently drops the tail).
 """
 
 from __future__ import annotations
@@ -40,6 +59,7 @@ import argparse
 import glob
 import json
 import os
+import statistics
 import sys
 import time
 from typing import Dict, List, Optional
@@ -60,18 +80,47 @@ def _is_higher_better(metric: str) -> bool:
 # loading
 
 
+def _rank_of(ev: dict) -> int:
+    return int(ev.get("rank", ev.get("proc", 0)))
+
+
+def _run_files(path: str) -> List[str]:
+    """The JSONL files of a run directory: rank-subdirectory layout
+    (``rank_<r>/events.jsonl``, current) or the legacy flat
+    ``events.p<proc>.jsonl`` files.  When BOTH are present the directory
+    holds two different runs (a pre-upgrade one plus a new one) — merging
+    them would interleave duplicate seq numbers into one corrupt
+    timeline, so the legacy files are ignored with a warning."""
+    rank_files = sorted(glob.glob(os.path.join(path, "rank_*", "*.jsonl")))
+    legacy = sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+    if rank_files and legacy:
+        if path not in _warned_mixed:      # once, not per follow poll
+            _warned_mixed.add(path)
+            print(f"[obs_report] {path}: ignoring {len(legacy)} legacy "
+                  "events.p*.jsonl file(s) beside rank_*/ streams — a "
+                  "reused run directory holds two different runs; point "
+                  "at a fresh directory to read the old run",
+                  file=sys.stderr)
+        return rank_files
+    return rank_files + legacy
+
+
+_warned_mixed: set = set()
+
+
 def load_events(path: str) -> List[dict]:
-    """Events of one run, ordered by (proc, seq).  Accepts a run directory,
+    """Events of one run, ordered by (rank, seq).  Accepts a run directory,
     one .jsonl file, or a BENCH_DETAIL-style .json (synthesized into
     ``bench_result`` events)."""
     if os.path.isdir(path):
-        files = sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+        files = _run_files(path)
         if not files:
-            raise FileNotFoundError(f"no events.p*.jsonl under {path}")
+            raise FileNotFoundError(
+                f"no rank_*/ or events.p*.jsonl streams under {path}")
         evs = []
         for f in files:
             evs.extend(_read_jsonl(f))
-        evs.sort(key=lambda e: (e.get("proc", 0), e.get("seq", 0)))
+        evs.sort(key=lambda e: (_rank_of(e), e.get("seq", 0)))
         return evs
     if path.endswith(".jsonl"):
         return _read_jsonl(path)
@@ -191,10 +240,29 @@ def run_summary(events: List[dict]) -> dict:
     snaps = [ev for ev in events if ev.get("kind") == "metrics_snapshot"]
     cache = _cache_rates(snaps[-1].get("metrics", {})) if snaps else None
 
+    # numerical-health counters (exchange overflow/invalid, nonfinite
+    # outputs — zero is the healthy reading, so they are surfaced even at
+    # zero) + the structured health events themselves
+    health_counters: Dict[str, int] = {}
+    if snaps:
+        for name, val in snaps[-1].get("metrics", {}) \
+                .get("counters", {}).items():
+            if name.split("{", 1)[0] in (
+                    "exchange_overflow", "exchange_invalid",
+                    "matvec_nonfinite", "health_events"):
+                health_counters[name] = int(val)
+    health_events = [
+        {k: ev.get(k) for k in ("rank", "kind", "check", "level", "solver",
+                                "engine", "iter", "count", "overflow",
+                                "invalid", "omega") if k in ev}
+        for ev in events if ev.get("kind") in ("health", "solver_health")]
+
     return {"n_events": len(events),
-            "processes": sorted({ev.get("proc", 0) for ev in events}),
+            "processes": sorted({_rank_of(ev) for ev in events}),
             "engine_inits": inits,
             "cache": cache,
+            "health": {"counters": health_counters,
+                       "events": health_events},
             "bench": bench_metrics(events),
             "solvers": solvers}
 
@@ -232,6 +300,20 @@ def print_summary(s: dict) -> None:
                   + (f"  hit_rate={rate:.1%}" if rate is not None else ""))
         print(f"  bytes_h2d={c['bytes_h2d']}  bytes_d2h={c['bytes_d2h']}  "
               f"retrace_count={c['retrace_count']}")
+    h = s.get("health") or {}
+    if h.get("counters") or h.get("events"):
+        print("\nnumerical health:")
+        for name, val in sorted((h.get("counters") or {}).items()):
+            print(f"  {name:<44} {val}")
+        evs = h.get("events") or []
+        if evs:
+            print(f"  {len(evs)} health event(s):")
+            for ev in evs[:10]:
+                detail = " ".join(
+                    f"{k}={v}" for k, v in ev.items() if k != "kind")
+                print(f"    {ev.get('kind')}: {detail}")
+        else:
+            print("  no health events (clean run)")
     if s["bench"]:
         print("\nbench results:")
         for cfg, m in sorted(s["bench"].items()):
@@ -253,6 +335,228 @@ def print_summary(s: dict) -> None:
                 res = max(t.get("residual") or [float("nan")])
                 print(f"  {str(t.get('iter')):<6} {str(t.get('basis_size')):<8}"
                       f" {ritz:<18.12g} {res:.3e}")
+
+
+# ---------------------------------------------------------------------------
+# merge / cross-rank skew
+
+
+def _sync_key(ev: dict):
+    """Match identity of an event that follows a cross-rank synchronization
+    point (collective engine builds, the SPMD apply barrier, solver entry/
+    exit) — or None for events with no cross-rank counterpart."""
+    kind = ev.get("kind")
+    if kind == "matvec_apply":
+        return ("matvec_apply", ev.get("engine"))
+    if kind in ("engine_init", "rank_shards"):
+        return (kind, ev.get("engine"), ev.get("mode"))
+    if kind in ("solver_start", "solver_end"):
+        return (kind, ev.get("solver"))
+    return None
+
+
+def _sync_points(events: List[dict]) -> Dict[int, Dict[tuple, float]]:
+    """Per rank: {match_key + occurrence ordinal: ts}.  Repeated events
+    align POSITIONALLY — SPMD ranks execute the same program order, so the
+    i-th occurrence on every rank is the same synchronization point."""
+    pts: Dict[int, Dict[tuple, float]] = {}
+    occ: Dict[int, Dict[tuple, int]] = {}
+    for ev in events:                       # (rank, seq)-ordered
+        k = _sync_key(ev)
+        if k is None or "ts" not in ev:
+            continue
+        r = _rank_of(ev)
+        i = occ.setdefault(r, {}).get(k, 0)
+        occ[r][k] = i + 1
+        pts.setdefault(r, {})[k + (i,)] = float(ev["ts"])
+    return pts
+
+
+def _median(vals: List[float]) -> float:
+    return statistics.median(vals) if vals else 0.0
+
+
+def estimate_skew(events: List[dict]) -> Dict[int, float]:
+    """{rank: seconds} — each rank's estimated wall-clock offset relative
+    to the lowest rank (median over matched sync events; the median is
+    robust against the genuine compute skew the report is trying to
+    surface).  Subtract a rank's offset from its ``ts`` to align."""
+    pts = _sync_points(events)
+    if not pts:
+        return {}
+    ranks = sorted(pts)
+    r0 = ranks[0]
+    offsets = {r0: 0.0}
+    for r in ranks[1:]:
+        common = set(pts[r0]) & set(pts[r])
+        offsets[r] = _median([pts[r][k] - pts[r0][k] for k in common]) \
+            if common else 0.0
+    return offsets
+
+
+def merge_events(events: List[dict]):
+    """(merged, offsets): every event gains a skew-corrected ``ts_adj`` and
+    the stream is ordered by ``(ts_adj, rank, seq)`` — one timeline for
+    the whole multi-rank run."""
+    offsets = estimate_skew(events)
+    merged = []
+    for ev in events:
+        e = dict(ev)
+        e["ts_adj"] = round(
+            float(ev.get("ts", 0.0)) - offsets.get(_rank_of(ev), 0.0), 6)
+        merged.append(e)
+    merged.sort(key=lambda e: (e["ts_adj"], _rank_of(e), e.get("seq", 0)))
+    return merged, offsets
+
+
+def straggler_report(events: List[dict],
+                     offsets: Optional[Dict[int, float]] = None) -> dict:
+    """Per-apply straggler attribution over the aligned ``matvec_apply``
+    events (the i-th apply on each rank is the same collective): the
+    straggler is the rank whose skew-corrected event lands LAST — every
+    other rank sat at the all_to_all barrier for (max − own) seconds — and
+    its excess is max − median (how much the barrier would shrink if the
+    straggler ran like a typical rank).
+
+    Caveat: the timestamps are host DISPATCH times (the telemetry layer
+    never adds a sync), so on deeply-async backends a slow device shows up
+    only once queue back-pressure or a solver's block fetch re-couples the
+    host to the device — interpret per-apply numbers there as block-level
+    skew, not per-collective truth.  Eager loops and the CPU rig track the
+    device closely and read directly."""
+    if offsets is None:
+        offsets = estimate_skew(events)
+    per: Dict[int, List[tuple]] = {}
+    for ev in events:
+        if ev.get("kind") == "matvec_apply" and "ts" in ev:
+            r = _rank_of(ev)
+            per.setdefault(r, []).append(
+                (float(ev["ts"]) - offsets.get(r, 0.0), ev.get("apply")))
+    ranks = sorted(per)
+    n = min((len(v) for v in per.values()), default=0)
+    stats = {r: {"barrier_wait_ms": 0.0, "straggled": 0, "excess_ms": 0.0}
+             for r in ranks}
+    worst = []
+    for i in range(n):
+        ts = {r: per[r][i][0] for r in ranks}
+        tmax = max(ts.values())
+        tmed = _median(list(ts.values()))
+        strag = max(ts, key=lambda r: ts[r])
+        excess = (tmax - tmed) * 1e3
+        for r in ranks:
+            stats[r]["barrier_wait_ms"] += (tmax - ts[r]) * 1e3
+        stats[strag]["straggled"] += 1
+        stats[strag]["excess_ms"] += excess
+        # carry the straggling EVENT's own apply field: a rank that ran
+        # several engines restarts each engine's apply counter, so the
+        # stream ordinal alone would not grep back to the actual event
+        worst.append((excess, i, per[strag][i][1], strag))
+    worst.sort(reverse=True, key=lambda w: w[0])
+    for r in ranks:
+        stats[r]["barrier_wait_ms"] = round(
+            stats[r]["barrier_wait_ms"] / n, 4) if n else 0.0
+        stats[r]["excess_ms"] = round(stats[r]["excess_ms"], 4)
+    return {"applies": n, "ranks": ranks, "per_rank": stats,
+            "worst": [{"ordinal": i, "apply": a, "rank": r,
+                       "excess_ms": round(e, 4)}
+                      for e, i, a, r in worst[:5] if e > 0]}
+
+
+def rank_table(events: List[dict],
+               offsets: Optional[Dict[int, float]] = None) -> dict:
+    """The per-rank skew table: events, survivor states (from
+    ``rank_shards``), eager applies + bytes exchanged (``matvec_apply``),
+    plan-build wall (``engine_init``), double-buffer stalls (final metrics
+    snapshot), estimated clock skew, mean time-at-barrier and straggler
+    counts (:func:`straggler_report`)."""
+    if offsets is None:
+        offsets = estimate_skew(events)
+    strag = straggler_report(events, offsets)
+    # collective vs replica topology: ranks of ONE sharded job own disjoint
+    # shard ids; overlapping ids mean rank-local replica engines (each rank
+    # holds everything) — there the barrier columns measure relative
+    # progress skew between replicas, not waits at a shared collective
+    shard_sets = {}
+    for ev in events:
+        if ev.get("kind") == "rank_shards" and ev.get("shards") is not None:
+            shard_sets[_rank_of(ev)] = set(ev["shards"])
+    collective = True
+    if len(shard_sets) > 1:
+        seen: set = set()
+        for s in shard_sets.values():
+            if seen & s:
+                collective = False
+                break
+            seen |= s
+    rows = []
+    for r in sorted({_rank_of(ev) for ev in events}):
+        mine = [ev for ev in events if _rank_of(ev) == r]
+        shards = [ev for ev in mine if ev.get("kind") == "rank_shards"]
+        applies = [ev for ev in mine if ev.get("kind") == "matvec_apply"]
+        inits = [ev for ev in mine if ev.get("kind") == "engine_init"]
+        snaps = [ev for ev in mine if ev.get("kind") == "metrics_snapshot"]
+        db = None
+        if snaps:
+            hists = snaps[-1].get("metrics", {}).get("histograms", {})
+            for name, h in hists.items():
+                if name.split("{", 1)[0] == "double_buffer_stall_ms":
+                    db = (db or 0.0) + float(h.get("sum", 0.0))
+        st = strag["per_rank"].get(r, {})
+        rows.append({
+            "rank": r,
+            "events": len(mine),
+            "states": int(shards[-1]["states"])
+            if shards and shards[-1].get("states") is not None else None,
+            "plan_wall_s": round(sum(
+                float(ev.get("build_structure_s") or 0.0)
+                for ev in inits), 4) if inits else None,
+            "applies": len(applies),
+            "bytes_exchanged": int(sum(
+                int(ev.get("bytes") or 0) for ev in applies)),
+            "db_stall_ms": round(db, 3) if db is not None else None,
+            "skew_s": round(offsets.get(r, 0.0), 6),
+            "barrier_wait_ms": st.get("barrier_wait_ms"),
+            "straggled": st.get("straggled"),
+        })
+    return {"rows": rows, "straggler": strag, "collective": collective}
+
+
+def _fmt_cell(v) -> str:
+    return "-" if v is None else str(v)
+
+
+def print_rank_report(table: dict, show_ranks: bool) -> None:
+    strag = table["straggler"]
+    if show_ranks:
+        cols = ("rank", "events", "states", "applies", "bytes_exchanged",
+                "plan_wall_s", "db_stall_ms", "skew_s", "barrier_wait_ms",
+                "straggled")
+        widths = {c: max(len(c), 12) for c in cols}
+        widths["rank"] = widths["events"] = widths["applies"] = 7
+        print("  ".join(f"{c:>{widths[c]}}" for c in cols))
+        for row in table["rows"]:
+            print("  ".join(f"{_fmt_cell(row.get(c)):>{widths[c]}}"
+                            for c in cols))
+    n = strag["applies"]
+    if not n:
+        print("no aligned matvec_apply events — straggler attribution "
+              "needs a multi-rank run with eager applies")
+        return
+    if table.get("collective") is False:
+        print("\nNOTE: ranks ran rank-local (replica) engines — no shared "
+              "collective exists, so the columns below measure relative "
+              "progress skew between replicas, not barrier waits")
+    print(f"\nstraggler attribution over {n} aligned applies "
+          "(excess = max - median arrival):")
+    for r in strag["ranks"]:
+        st = strag["per_rank"][r]
+        print(f"  rank {r}: straggled {st['straggled']}/{n} applies, "
+              f"total excess {st['excess_ms']:.3f} ms, "
+              f"mean barrier wait {st['barrier_wait_ms']:.3f} ms")
+    if strag["worst"]:
+        w = strag["worst"][0]
+        print(f"  worst apply: #{w['apply']} on rank {w['rank']} "
+              f"(+{w['excess_ms']:.3f} ms over median)")
 
 
 # ---------------------------------------------------------------------------
@@ -328,6 +632,15 @@ def _short(v, cap: int = 60) -> str:
     return s if len(s) <= cap else s[: cap - 3] + "..."
 
 
+def _stat_id(path: str):
+    """(inode, device) of a file, or None when it vanished mid-poll."""
+    try:
+        st = os.stat(path)
+        return (st.st_ino, st.st_dev)
+    except OSError:
+        return None
+
+
 def tail_run(path: str, n: int, follow: bool) -> None:
     evs = load_events(path)
     for ev in evs[-n:]:
@@ -338,41 +651,87 @@ def tail_run(path: str, n: int, follow: bool) -> None:
         print("--follow needs a run directory or .jsonl file",
               file=sys.stderr)
         return
-    files = (sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
-             if os.path.isdir(path) else [path])
-    offsets = {f: os.path.getsize(f) for f in files}
+    files = _run_files(path) if os.path.isdir(path) else [path]
+    # per-file follow state: (inode id, byte offset, head-of-file bytes).
+    # All three are checked every poll so a rotated/recreated file is
+    # reopened from 0 instead of silently losing every event the new
+    # writer appends: a new inode catches rename-style rotation, size <
+    # offset catches in-place truncation seen while still small, and the
+    # head fingerprint catches in-place truncation that REGREW past the
+    # old offset between two polls (same inode, larger size — invisible
+    # to the other two checks).  A file vanishing between the glob and
+    # the stat (mid-rotation) is simply picked up by a later poll.
+    state = {}
+    for f in files:
+        try:
+            state[f] = (_stat_id(f), os.path.getsize(f), _head_bytes(f))
+        except OSError:
+            continue
     partial: Dict[str, str] = {}
     try:
         while True:
             time.sleep(0.5)
-            if os.path.isdir(path):  # pick up files of late-joining procs
-                files = sorted(glob.glob(
-                    os.path.join(path, "events.p*.jsonl")))
-            for f in files:
-                size = os.path.getsize(f)
-                off = offsets.get(f, 0)
-                if size <= off:
-                    continue
-                with open(f) as fh:
-                    fh.seek(off)
-                    chunk = fh.read(size - off)
-                offsets[f] = size
-                # a read can land mid-write: keep the torn final fragment
-                # buffered until its newline arrives instead of dropping
-                # the event
-                data = partial.pop(f, "") + chunk
-                lines = data.split("\n")
-                if lines[-1]:
-                    partial[f] = lines[-1]
-                for line in lines[:-1]:
-                    if not line.strip():
-                        continue
-                    try:
-                        print(_fmt_event(json.loads(line)))
-                    except json.JSONDecodeError:
-                        pass
+            if os.path.isdir(path):  # pick up files of late-joining ranks
+                files = _run_files(path)
+            for ev in _follow_poll(files, state, partial):
+                print(_fmt_event(ev))
     except KeyboardInterrupt:
         pass
+
+
+def _head_bytes(path: str, n: int = 64) -> bytes:
+    """First ``n`` bytes of a file (the rotation fingerprint), or b''."""
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(n)
+    except OSError:
+        return b""
+
+
+def _follow_poll(files: List[str], state: Dict[str, tuple],
+                 partial: Dict[str, str]) -> List[dict]:
+    """One --follow poll step over ``files``, mutating the per-file
+    ``state``/``partial`` maps; returns the newly complete events."""
+    out: List[dict] = []
+    for f in files:
+        ident = _stat_id(f)
+        if ident is None:
+            continue
+        old_ident, off, head = state.get(f, (None, 0, b""))
+        try:
+            size = os.path.getsize(f)
+        except OSError:     # vanished between stat and size
+            continue
+        head_now = _head_bytes(f)
+        if ident != old_ident or size < off \
+                or not head_now.startswith(head):
+            # rotated (new inode), truncated in place, or truncated AND
+            # regrown past the old offset (same inode, changed head):
+            # restart from the top of the NEW file; a torn fragment from
+            # the old one can never complete
+            off = 0
+            partial.pop(f, None)
+        if size <= off:
+            state[f] = (ident, off, head_now)
+            continue
+        with open(f) as fh:
+            fh.seek(off)
+            chunk = fh.read(size - off)
+        state[f] = (ident, size, head_now)
+        # a read can land mid-write: keep the torn final fragment buffered
+        # until its newline arrives instead of dropping the event
+        data = partial.pop(f, "") + chunk
+        lines = data.split("\n")
+        if lines[-1]:
+            partial[f] = lines[-1]
+        for line in lines[:-1]:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -387,6 +746,21 @@ def main(argv=None) -> int:
     p.add_argument("run", help="run dir, .jsonl file, or BENCH_DETAIL.json")
     p.add_argument("--json", action="store_true",
                    help="print the machine-readable summary dict")
+
+    p = sub.add_parser("merge", help="multi-rank run -> one ordered, "
+                                     "skew-corrected timeline")
+    p.add_argument("run", help="run dir with rank_*/ (or events.p*.jsonl)")
+    p.add_argument("-o", "--out", default=None, metavar="OUT.jsonl",
+                   help="write the merged JSONL here (default: stdout)")
+
+    p = sub.add_parser("report", help="cross-rank skew + straggler report")
+    p.add_argument("run")
+    p.add_argument("--ranks", action="store_true",
+                   help="include the per-rank skew table (events, survivor "
+                        "states, bytes exchanged, plan wall, stalls, "
+                        "time-at-barrier)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable table dict")
 
     p = sub.add_parser("diff", help="two runs -> regression report "
                                     "(exit 1 on gated regression)")
@@ -415,6 +789,31 @@ def main(argv=None) -> int:
             print(json.dumps(summary, indent=1, sort_keys=True))
         else:
             print_summary(summary)
+        return 0
+
+    if args.cmd == "merge":
+        merged, offsets = merge_events(load_events(args.run))
+        ranks = ", ".join(f"rank {r}: {off:+.6f}s"
+                          for r, off in sorted(offsets.items()))
+        print(f"[obs_report] merged {len(merged)} events from "
+              f"{len(offsets)} rank(s); clock-skew estimate: {ranks or '-'}",
+              file=sys.stderr)
+        out = open(args.out, "w") if args.out else sys.stdout
+        try:
+            for ev in merged:
+                out.write(json.dumps(ev) + "\n")
+        finally:
+            if args.out:
+                out.close()
+        return 0
+
+    if args.cmd == "report":
+        events = load_events(args.run)
+        table = rank_table(events)
+        if args.json:
+            print(json.dumps(table, indent=1, sort_keys=True))
+        else:
+            print_rank_report(table, show_ranks=args.ranks)
         return 0
 
     if args.cmd == "diff":
