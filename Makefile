@@ -12,12 +12,13 @@ OBS_THRESHOLD ?= 0.2
 HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
-	obs-check health-check clean
+	obs-check health-check mem-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
 	$(MAKE) obs-check
 	$(MAKE) health-check
+	$(MAKE) mem-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -66,6 +67,15 @@ obs-check:
 	      "(timing noise vs a genuine regression resolves by attempt 3)"; \
 	  fi; \
 	done; exit $$ok
+
+# Memory-observability gate (tools/mem_check.py): chain-16 smoke run,
+# asserting the device-memory ledger reconciles with ell_nbytes exactly
+# and with the apply executable's memory_analysis() within tolerance,
+# that the obs stream carries memory_ledger/memory_analysis events the
+# capacity planner can read, and that a healthy run emits ZERO
+# OOM/critical memory events.
+mem-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/mem_check.py
 
 # Numerical-health gate (tools/health_check.py): chain-16 smoke applies
 # with probes on vs off in ONE process (same warm engine — cross-process

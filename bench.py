@@ -217,6 +217,21 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         "batch4_max_err_vs_single": batch4_err,
     }
 
+    # memory observability columns (`obs_report diff --memory` gates on
+    # these): resident table bytes, the apply executable's compile-time
+    # analysis, and the device watermark (absent on statless backends —
+    # the CPU client returns no memory_stats)
+    if obs.obs_enabled():
+        out["table_bytes"] = int(eng.ell_nbytes)
+        ana = eng.apply_memory_analysis(xj)
+        if ana:
+            out["executable_temp_bytes"] = int(ana["temp_bytes"])
+            out["executable_argument_bytes"] = int(ana["argument_bytes"])
+            out["executable_peak_bytes"] = int(ana["peak_estimate_bytes"])
+        wm = obs.sample_watermark(f"bench/{name}")
+        if wm:
+            out["peak_hbm_bytes"] = int(wm["peak_bytes"])
+
     if solver_iters:
         from distributed_matvec_tpu.solve.lanczos import lanczos
 

@@ -40,6 +40,11 @@ from .events import (annotate, emit, event_path, events, flush, obs_enabled,
 from .health import (HealthError, drain as drain_health, health_event_count,
                      health_mode, probes_enabled, record as record_health,
                      reset_health)
+from .memory import (MemoryReport, OomError, attach_oom,
+                     build_memory_report, emit_ledger, executable_analyses,
+                     last_watermark, ledger_entries, ledger_total,
+                     ledger_tree, record_executable_analysis, reset_memory,
+                     sample_watermark, track, track_tree, watermark_due)
 from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
                       reset_metrics, series_name)
 from .metrics import snapshot as _metrics_snapshot
@@ -68,6 +73,22 @@ __all__ = [
     "probes_enabled",
     "record_health",
     "reset_health",
+    "MemoryReport",
+    "OomError",
+    "attach_oom",
+    "build_memory_report",
+    "emit_ledger",
+    "executable_analyses",
+    "last_watermark",
+    "ledger_entries",
+    "ledger_total",
+    "ledger_tree",
+    "record_executable_analysis",
+    "reset_memory",
+    "sample_watermark",
+    "track",
+    "track_tree",
+    "watermark_due",
 ]
 
 
@@ -80,7 +101,9 @@ def snapshot() -> dict:
 
 
 def reset_all() -> None:
-    """Reset events, metrics AND health state (test isolation helper)."""
+    """Reset events, metrics, health AND memory state (test isolation
+    helper)."""
     reset()
     reset_metrics()
     reset_health()
+    reset_memory()

@@ -64,17 +64,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.operator import Operator
 from ..obs import annotate, counter, emit, histogram, obs_enabled
 from ..obs import health as obs_health
+from ..obs import memory as obs_memory
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
-from .engine import (SENTINEL_STATE, apply_diag_jit,
+from .engine import (SENTINEL_STATE, analyze_bound_apply, apply_diag_jit,
                      attach_traced_counter_check,
                      check_complex_backend, choose_ell_split,
-                     emit_engine_init, gather_coefficients_jit, precompile,
-                     raise_deferred_failure, record_structure_cache,
+                     emit_engine_init, gather_coefficients_jit, oom_reraise,
+                     precompile, raise_deferred_failure,
+                     record_structure_cache, register_engine_memory,
                      compact_magnitude, unroll_terms_ok, use_pair_complex)
 from .mesh import (SHARD_AXIS, make_mesh, pcast_varying,
                    shard_map_compat, shard_spec)
@@ -149,6 +151,9 @@ class DistributedEngine:
         self._dtype = jnp.float64 if (self.real or self.pair) \
             else jnp.complex128
         self.timer = TreeTimer("DistributedEngine")
+        # pre-build watermark: the delta against the post-init sample in
+        # register_engine_memory is the construction's device footprint
+        obs_memory.sample_watermark("engine_init_start/distributed")
 
         D = self.n_devices
         self._shards_path = shards_path
@@ -302,7 +307,15 @@ class DistributedEngine:
             if not self.structure_restored:
                 with self.timer.scope("build_plan"), \
                         annotate("engine_init/build_plan"):
-                    self._plan_stream(row_provider, compact=False)
+                    try:
+                        self._plan_stream(row_provider, compact=False)
+                    except Exception as e:
+                        if not obs_memory.is_resource_exhausted(e):
+                            getattr(self, "_plan_stage_h",
+                                    obs_memory.NULL_HANDLE).release()
+                        oom_reraise(e, engine="distributed", mode=mode,
+                                    phase="init",
+                                    n_states=int(self.n_states))
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_ell_matvec()
             self._checked.add(None)  # static plan: no data-dependent capacity
@@ -346,7 +359,15 @@ class DistributedEngine:
                 self._c_W = float(vals[0]) if vals.size else 0.0
                 with self.timer.scope("build_plan"), \
                         annotate("engine_init/build_plan"):
-                    self._plan_stream(row_provider, compact=True)
+                    try:
+                        self._plan_stream(row_provider, compact=True)
+                    except Exception as e:
+                        if not obs_memory.is_resource_exhausted(e):
+                            getattr(self, "_plan_stage_h",
+                                    obs_memory.NULL_HANDLE).release()
+                        oom_reraise(e, engine="distributed", mode=mode,
+                                    phase="init",
+                                    n_states=int(self.n_states))
                 self._save_structure(structure_cache, soft=soft_save)
                 self._c_n_all_shards = None   # only needed by the save above
             self._matvec = self._make_compact_matvec()
@@ -402,6 +423,7 @@ class DistributedEngine:
                 else {"remote_entries": int(self._plan_remote_unique)}))
         emit_engine_init(self, "distributed",
                          init_s=time.perf_counter() - _t_init)
+        register_engine_memory(self, "distributed")
         self.timer.report()  # tree print, gated by display_timings
 
     @classmethod
@@ -534,6 +556,21 @@ class DistributedEngine:
 
         Bc = min(M, max(self.batch_size, 8))
         nchunks = (M + Bc - 1) // Bc
+
+        # the build's staged stream buffers go in the memory ledger for its
+        # duration: double-buffered chunk uploads plus the gathered
+        # (betas, cf) fetches — what an OOM during the plan build points at
+        _mem_h = obs_memory.NULL_HANDLE
+        if obs_enabled():
+            cfb = 16 if (self.pair or not self.real) else 8
+            stage = 2 * (Bc * 16 + Bc * T * (8 + cfb))
+            _mem_h = obs_memory.track(
+                f"plan/{obs_memory.next_instance('plan_stream')}/staging",
+                stage, kind="staging", chunks=int(nchunks))
+        # kept on self so the __init__ guard can drop the entry when a
+        # NON-OOM build failure unwinds (the staging is freed with the
+        # frame then; only a genuine OOM should keep it for forensics)
+        self._plan_stage_h = _mem_h
 
         # ONE fixed-shape gather program (every chunk is padded to Bc rows),
         # AOT-compiled once per (shapes, pair) process-wide and shared with
@@ -864,6 +901,8 @@ class DistributedEngine:
                 self._ell_tail = (self._assemble_sharded(trow_shards),
                                   self._assemble_sharded(tidx_shards),
                                   self._assemble_sharded(tcf_shards))
+        _mem_h.release()           # stream staging gone; tables resident
+        obs_memory.sample_watermark("plan_upload/distributed")
 
     def _finish_compact_aux(self, n_all_dev) -> None:
         """Derived compact-mode device arrays (recomputed on cache restore).
@@ -1609,7 +1648,19 @@ class DistributedEngine:
         First call (or ``check=True``) validates the overflow and
         invalid-state counters — the loud-failure analogs of the reference's
         blocking buffers and halt (DistributedMatrixVector.chpl:113-118).
+
+        A device out-of-memory failure surfaces as a typed
+        :class:`~..obs.memory.OomError` with the memory-forensics report
+        attached; with the obs layer off the original error propagates
+        untouched.
         """
+        try:
+            return self._matvec_impl(xh, check)
+        except Exception as e:
+            oom_reraise(e, engine="distributed", mode=self.mode,
+                        phase="apply", n_states=int(self.n_states))
+
+    def _matvec_impl(self, xh, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()
@@ -1661,6 +1712,8 @@ class DistributedEngine:
                                                    overflow, invalid)
             if obs_health.probe_due(idx):
                 obs_health.probe_apply("distributed", y, idx)
+            if obs_memory.watermark_due(idx):
+                obs_memory.sample_watermark("apply/distributed", apply=idx)
         dt_ms = (time.perf_counter() - _t0) * 1e3
         if obs_enabled():
             # one rank-tagged event per eager apply: the raw material of
@@ -1749,12 +1802,56 @@ class DistributedEngine:
         jit-composition contract (no large closure constants)."""
         return self._apply_fn, self._operands
 
+    def structure_arrays(self) -> dict:
+        """The live precomputed plan/structure arrays by name (empty in
+        fused mode) — the single enumeration the memory ledger registers
+        and :attr:`ell_nbytes` sums, parity-tested per mode.  Includes the
+        static routing plan (``qin``) the apply's ``all_to_all`` gathers
+        from; compact mode's derived norm tables count too (they were
+        silently missing from the hand-maintained total before)."""
+        if self.mode == "ell":
+            out = {"idx": self._ell_idx, "coeff": self._ell_coeff,
+                   "qin": self._qin}
+            if self._ell_tail is not None:
+                rows, t_idx, t_cf = self._ell_tail
+                out.update(tail_rows=rows, tail_idx=t_idx, tail_coeff=t_cf)
+            return out
+        if self.mode == "compact":
+            out = {"idx": self._c_idx, "qin": self._qin,
+                   "inv_n": self._c_inv_n, "n_parts": self._c_n_parts,
+                   "norms_all": self._c_norms}
+            if self._c_tail is not None:
+                rows, t_idx = self._c_tail
+                out.update(tail_rows=rows, tail_idx=t_idx)
+            return out
+        return {}
+
+    def memory_arrays(self) -> dict:
+        """Every resident device-array group by ledger name (fused mode
+        carries the per-shard lookup instead of structure tables)."""
+        out = {"operator_tables": self.tables,
+               "basis_rows": (self._alphas, self._norms),
+               "diag": self._diag}
+        if self.mode == "fused":
+            out["lookup"] = (self._lk_pair, self._lk_dir)
+        for name, arrs in self.structure_arrays().items():
+            out[f"structure/{name}"] = arrs
+        return out
+
+    def apply_memory_analysis(self, xh=None) -> Optional[dict]:
+        """Compile-time memory analysis of the apply program for ``xh``'s
+        shapes (a zero hashed vector by default) — see
+        :meth:`LocalEngine.apply_memory_analysis`."""
+        if xh is None:
+            shape = (self.n_devices, self.shard_size) \
+                + ((2,) if self.pair else ())
+            xh = jnp.zeros(shape, self._dtype)  # f64, or c128 native-complex
+        return analyze_bound_apply(self, "distributed", xh)
+
     @property
     def ell_nbytes(self) -> int:
-        if self.mode != "ell":
-            return 0
-        total = (self._ell_idx.nbytes + self._ell_coeff.nbytes
-                 + self._qin.nbytes)
-        if self._ell_tail is not None:
-            total += sum(a.nbytes for a in self._ell_tail)
-        return total
+        """Device memory held by the precomputed plan structure (0 in
+        fused mode) — the summed ``nbytes`` of the live
+        :meth:`structure_arrays` leaves."""
+        return sum(int(a.nbytes) for a in jax.tree_util.tree_leaves(
+            self.structure_arrays()))

@@ -42,8 +42,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.operator import Operator
-from ..obs import annotate, counter, emit, histogram
+from ..obs import annotate, counter, emit, gauge, histogram
 from ..obs import health as obs_health
+from ..obs import memory as obs_memory
+from ..obs.events import obs_enabled
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled, split_parts
@@ -98,6 +100,16 @@ def _shape_key(args) -> tuple:
                  for leaf in jax.tree_util.tree_leaves(args))
 
 
+def _analysis_key(name: str, statics: tuple, shapes: tuple) -> str:
+    """Stable id for one compiled specialization of a program: the memory
+    ledger and the analysis registry must distinguish shape variants of
+    the same builder without carrying the full shape tuple around."""
+    import hashlib
+
+    h = hashlib.sha256(repr((statics, shapes)).encode()).hexdigest()[:8]
+    return f"{name}@{h}"
+
+
 def precompile(name: str, statics: tuple, jit_fn, args, timer) -> Any:
     """Compile ``jit_fn`` for ``args``' shapes once per (name, statics,
     shapes) and return the executable; compile time lands in ``timer``'s
@@ -114,6 +126,11 @@ def precompile(name: str, statics: tuple, jit_fn, args, timer) -> Any:
         with timer.scope("compile"), annotate(f"compile/{name}"):
             ex = jit_fn.lower(*args).compile()
         _PROGRAM_CACHE[key] = ex
+        # compile-time memory facts for every AOT-cached executable:
+        # argument/output/temp/generated-code bytes, emitted + persisted
+        # next to the XLA artifact cache (obs/memory.py; no-op when off)
+        obs_memory.record_executable_analysis(
+            _analysis_key(name, statics, shapes), ex, program=name)
     else:
         counter("aot_executable_cache", event="hit").inc()
     return ex
@@ -340,6 +357,87 @@ def emit_engine_init(eng, engine_kind: str, init_s: Optional[float] = None
          transfer_s=round(t.scope_total("transfer"), 6),
          diag_s=round(t.scope_total("diag"), 6),
          **({} if init_s is None else {"init_s": round(init_s, 6)}))
+
+
+def oom_reraise(exc: BaseException, **context) -> None:
+    """Shared error-path hook for engine build/apply: a device
+    ``RESOURCE_EXHAUSTED`` failure is re-raised as a typed
+    :class:`~..obs.memory.OomError` carrying the forensics report (ledger
+    tree + last watermark + executable analyses + remediation); any other
+    exception — or any exception with the obs layer off — propagates
+    untouched.  Lives on the except path only: the happy path pays
+    nothing."""
+    oom = obs_memory.attach_oom(exc, **context)
+    if oom is not None:
+        raise oom from exc
+    raise exc
+
+
+def register_engine_memory(eng, engine_kind: str) -> None:
+    """Register the engine's resident device arrays in the memory ledger
+    (released automatically when the engine is garbage-collected) and emit
+    one ``memory_ledger`` event whose context fields — mode, sizes, T0,
+    table bytes — are everything ``tools/capacity.py`` needs to predict
+    bytes/row per mode from the snapshot alone.  Shared by both engines so
+    the attribution paths and the event schema cannot drift."""
+    if not obs_enabled():
+        return
+    import weakref
+
+    inst = obs_memory.next_instance(engine_kind)
+    eng._mem_instance = inst
+    base = f"engine/{inst}"
+    h = None
+    for name, tree in eng.memory_arrays().items():
+        h = obs_memory.track_tree(f"{base}/{name}", tree, device="device",
+                                  handle=h)
+    if h is not None:
+        weakref.finalize(eng, h.release)
+    table_bytes = int(eng.ell_nbytes)
+    gauge("engine_table_bytes", engine=engine_kind).set(table_bytes)
+    ctx = dict(engine=engine_kind, instance=inst, mode=eng.mode,
+               n_states=int(eng.n_states), num_terms=int(eng.num_terms),
+               pair=bool(eng.pair), real=bool(eng.real),
+               batch_size=int(eng.batch_size),
+               T0=int(getattr(eng, "_ell_T0", 0) or 0),
+               table_bytes=table_bytes)
+    if hasattr(eng, "n_padded"):
+        ctx["n_padded"] = int(eng.n_padded)
+    if hasattr(eng, "shard_size"):
+        ctx.update(shard_size=int(eng.shard_size),
+                   n_devices=int(eng.n_devices))
+    if hasattr(eng, "query_capacity"):
+        ctx["query_capacity"] = int(eng.query_capacity)
+    elif getattr(eng, "_capacity", None) is not None:
+        ctx["exchange_capacity"] = int(eng._capacity)
+    obs_memory.emit_ledger(f"engine_init/{engine_kind}", **ctx)
+    obs_memory.sample_watermark(f"engine_init/{engine_kind}")
+
+
+def analyze_bound_apply(eng, engine_kind: str, x):
+    """AOT-compile the engine's bound apply program for ``x``'s shapes and
+    record its compiled memory analysis (``memory_analysis`` event +
+    registry).  Explicit and offline by design: it costs one compile — a
+    process-cache hit on repeat calls, and a persistent XLA-cache hit
+    across processes when the artifact layer is on — so the engines never
+    pay it on the hot path.  Returns the analysis dict, or None when the
+    obs layer is off or the backend exposes no analysis."""
+    if not obs_enabled():
+        return None
+    from ..utils.logging import log_debug as _dbg
+
+    args = (jnp.asarray(x), eng._operands)
+    name = f"{engine_kind}_{eng.mode}_apply"
+    try:
+        ex = precompile(name, (), jax.jit(eng._apply_fn), args, eng.timer)
+    except Exception as e:   # lowering quirks must not fail a report
+        _dbg(f"apply memory analysis unavailable ({name}): {e!r}")
+        return None
+    key = _analysis_key(name, (), _shape_key(args))
+    ana = obs_memory.executable_analyses().get(key)
+    if ana is None:
+        ana = obs_memory.record_executable_analysis(key, ex, program=name)
+    return ana
 
 
 def record_structure_cache(restored: bool, consulted: bool) -> None:
@@ -589,6 +687,9 @@ class LocalEngine:
         self.batch_size = b
         self.num_chunks = n_pad // b
         self.timer = TreeTimer("LocalEngine")
+        # pre-build watermark: the delta against the post-init sample in
+        # register_engine_memory is the construction's device footprint
+        obs_memory.sample_watermark("engine_init_start/local")
 
         # Persistent XLA compilation cache under the artifact root (no-op
         # when the artifact layer is off or a harness already chose a dir).
@@ -639,7 +740,11 @@ class LocalEngine:
             if not self.structure_restored:
                 with self.timer.scope("build_structure"), \
                         annotate("engine_init/build_structure"):
-                    self._build_ell()
+                    try:
+                        self._build_ell()
+                    except Exception as e:
+                        oom_reraise(e, engine="local", mode=mode,
+                                    phase="init", n_states=int(n))
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_ell_matvec()
             self._checked = True                  # validated at build time
@@ -650,7 +755,11 @@ class LocalEngine:
             if not self.structure_restored:
                 with self.timer.scope("build_structure"), \
                         annotate("engine_init/build_structure"):
-                    self._build_compact()
+                    try:
+                        self._build_compact()
+                    except Exception as e:
+                        oom_reraise(e, engine="local", mode=mode,
+                                    phase="init", n_states=int(n))
                 self._save_structure(structure_cache, soft=soft_save)
             self._matvec = self._make_compact_matvec()
             self._checked = True                  # validated at build time
@@ -662,6 +771,7 @@ class LocalEngine:
         self._apply_idx = 0
         emit_engine_init(self, "local",
                          init_s=time.perf_counter() - _t_init)
+        register_engine_memory(self, "local")
         self.timer.report()  # tree print, gated by display_timings
 
     # -- structure checkpoint (ell/compact) ---------------------------------
@@ -1283,7 +1393,19 @@ class LocalEngine:
         nonzero matrix element targets a state outside the basis — the
         engine-level halt of the reference (DistributedMatrixVector.chpl:113-118).
         In ell mode that check already ran at structure-build time.
+
+        A device out-of-memory failure surfaces as a typed
+        :class:`~..obs.memory.OomError` with the memory-forensics report
+        attached (ledger + watermark + analyses + remediation); with the
+        obs layer off the original error propagates untouched.
         """
+        try:
+            return self._matvec_impl(x, check)
+        except Exception as e:
+            oom_reraise(e, engine="local", mode=self.mode, phase="apply",
+                        n_states=int(self.n_states))
+
+    def _matvec_impl(self, x, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()
@@ -1330,6 +1452,9 @@ class LocalEngine:
             obs_health.drain()
             if obs_health.probe_due(self._apply_idx):
                 obs_health.probe_apply("local", y, self._apply_idx)
+            if obs_memory.watermark_due(self._apply_idx):
+                obs_memory.sample_watermark("apply/local",
+                                            apply=self._apply_idx)
             self._apply_idx += 1
         histogram("matvec_apply_ms", engine="local").observe(
             (time.perf_counter() - _t0) * 1e3)
@@ -1357,18 +1482,55 @@ class LocalEngine:
         """
         return self._apply_fn, self._operands
 
+    def structure_arrays(self) -> Dict[str, Any]:
+        """The live precomputed-structure arrays by name (empty in fused
+        mode).  The ONE enumeration the memory ledger registers and
+        :attr:`ell_nbytes` sums — reported bytes cannot drift from the
+        tables actually resident (the parity tests in
+        ``tests/test_memory_obs.py`` pin each mode's expected contents)."""
+        if self.mode == "ell":
+            out = {"idx": self._ell_idx, "coeff": self._ell_coeff}
+            if self._ell_tail is not None:
+                rows, t_idx, t_cf = self._ell_tail
+                out.update(tail_rows=rows, tail_idx=t_idx, tail_coeff=t_cf)
+            return out
+        if self.mode == "compact":
+            out = {"idx": self._c_idx, "inv_n": self._c_inv_n,
+                   "n_parts": self._c_n_parts}
+            if self._c_tail is not None:
+                rows, t_idx = self._c_tail
+                out.update(tail_rows=rows, tail_idx=t_idx)
+            return out
+        return {}
+
+    def memory_arrays(self) -> Dict[str, Any]:
+        """Every resident device-array group by ledger name: the operator
+        term tables, the basis lookup, the padded representative/norm
+        rows, the diagonal, and the per-mode structure tables."""
+        out = {"operator_tables": self.tables,
+               "lookup": (self._lk_pair, self._lk_dir),
+               "basis_rows": (self._alphas, self._norms),
+               "diag": self._diag}
+        for name, arrs in self.structure_arrays().items():
+            out[f"structure/{name}"] = arrs
+        return out
+
+    def apply_memory_analysis(self, x=None) -> Optional[dict]:
+        """Compile-time memory analysis of the apply program for ``x``'s
+        shapes (a zero single vector by default): argument/output/temp
+        bytes per the compiler's own accounting, recorded as a
+        ``memory_analysis`` event.  Costs one AOT compile (process- and
+        persistent-cache amortized) — call it from harnesses, not hot
+        loops."""
+        if x is None:
+            shape = (self.n_states, 2) if self.pair else (self.n_states,)
+            x = jnp.zeros(shape, self._dtype)   # f64, or c128 native-complex
+        return analyze_bound_apply(self, "local", x)
+
     @property
     def ell_nbytes(self) -> int:
-        """Device memory held by the precomputed structure (0 in fused mode)."""
-        if self.mode == "compact":
-            total = (self._c_idx.nbytes + self._c_n_parts.nbytes
-                     + self._c_inv_n.nbytes)
-            if self._c_tail is not None:
-                total += sum(a.nbytes for a in self._c_tail)
-            return total
-        if self.mode != "ell":
-            return 0
-        total = self._ell_idx.nbytes + self._ell_coeff.nbytes
-        if self._ell_tail is not None:
-            total += sum(a.nbytes for a in self._ell_tail)
-        return total
+        """Device memory held by the precomputed structure (0 in fused
+        mode) — the summed ``nbytes`` of the live
+        :meth:`structure_arrays` leaves."""
+        return sum(int(a.nbytes) for a in jax.tree_util.tree_leaves(
+            self.structure_arrays()))

@@ -41,6 +41,7 @@ import numpy as np
 from scipy.linalg import eigh
 
 from ..obs import health as obs_health
+from ..obs import memory as obs_memory
 from ..obs.events import emit as obs_emit, obs_enabled
 
 __all__ = ["LanczosResult", "lanczos", "lanczos_block"]
@@ -475,6 +476,16 @@ def lanczos_block(
     obs_emit("solver_start", solver="lanczos_block", k=int(k),
              block_size=int(p), max_iters=int(max_iters), tol=float(tol))
 
+    # unbounded-basis solver: the block list GROWS — the ledger entry is
+    # updated per appended block so forensics show the live footprint
+    mem_h = obs_memory.NULL_HANDLE
+    blk_path = None
+    if obs_enabled():
+        blk_path = (f"solver/{obs_memory.next_instance('lanczos_block')}"
+                    "/block_basis")
+        mem_h = obs_memory.track(blk_path, int(Q.nbytes),
+                                 block_size=int(p))
+
     for j in range(max_blocks):
         t0 = _time.perf_counter()
         Qj = blocks[-1]
@@ -542,6 +553,8 @@ def lanczos_block(
             break
         watchdog.check_stagnation(res, total)
         blocks.append(Qn)
+        if blk_path is not None:
+            mem_h.set(blk_path, int(Q.nbytes) * len(blocks))
 
     kk = min(k, len(A_list) * p)
     evecs = None
@@ -559,6 +572,7 @@ def lanczos_block(
              converged=bool(converged),
              eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
              if theta is not None else [])
+    mem_h.release()
     return LanczosResult(
         eigenvalues=np.asarray(theta[:kk]) if theta is not None
         else np.zeros(0),
@@ -678,6 +692,17 @@ def lanczos(
     run_block = _make_block_runner(mv, mcap, shape, dtype, n_reorth,
                                    pair=pair)
     restart_fn = _make_restart(mcap, shape, dtype, l_restart)
+
+    # the Krylov buffer is the solver's whole device footprint — register
+    # it in the memory ledger for the solve's lifetime (released at normal
+    # completion; a failed solve keeps the entry live, which is what an
+    # OOM forensics report should show)
+    mem_h = obs_memory.NULL_HANDLE
+    if obs_enabled():
+        mem_h = obs_memory.track(
+            f"solver/{obs_memory.next_instance('lanczos')}/krylov_basis",
+            int(V.nbytes) + int(alph_d.nbytes) + int(bet_d.nbytes),
+            rows=int(_buffer_rows(mcap)))
 
     lock_theta = np.zeros(0)
     lock_sigma = np.zeros(0)
@@ -857,6 +882,7 @@ def lanczos(
              converged=bool(converged),
              eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
              if theta is not None else [])
+    mem_h.release()
     return LanczosResult(
         eigenvalues=np.asarray(theta[:kk]) if theta is not None
         else np.zeros(0),

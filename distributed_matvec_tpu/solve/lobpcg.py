@@ -35,17 +35,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import health as obs_health
-from ..obs.events import emit as obs_emit
+from ..obs import memory as obs_memory
+from ..obs.events import emit as obs_emit, obs_enabled
 
 __all__ = ["lobpcg"]
 
 
-def _emit_end(iters: int, evals) -> None:
+def _emit_end(iters: int, evals,
+              mem_h: obs_memory.Handle = obs_memory.NULL_HANDLE) -> None:
     """Final telemetry event (lobpcg_standard's jitted while_loop exposes no
     per-iteration host callback, so unlike Lanczos the trace granularity
     here is the solve, not the step — and the health check likewise runs on
     the finished spectrum: a NaN/Inf eigenvalue is the one silent-decay
-    signature visible at this granularity)."""
+    signature visible at this granularity).  Also releases the solve's
+    memory-ledger registration."""
+    mem_h.release()
     vals = [float(v) for v in np.atleast_1d(evals)]
     obs_emit("solver_end", solver="lobpcg", iters=int(iters),
              eigenvalues=vals)
@@ -105,6 +109,17 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         pair = bool(getattr(owner, "pair", False))
     obs_emit("solver_start", solver="lobpcg", k=int(k),
              max_iters=int(max_iters), tol=float(tol), pair=bool(pair))
+    # lobpcg_standard keeps X, P, R plus their H-applies resident — ~6
+    # blocks of [n(, 2), m] columns; an estimate, flagged as such, so OOM
+    # forensics attribute block-solver footprint without instrumenting
+    # jax's own solver internals
+    mem_h = obs_memory.NULL_HANDLE
+    if obs_enabled():
+        cols = 2 * k if pair else k
+        mem_h = obs_memory.track(
+            f"solver/{obs_memory.next_instance('lobpcg')}/block_workspace",
+            6 * 8 * int(n) * max(int(cols), 1) * (2 if pair else 1),
+            estimate=True, k=int(k))
     dist = owner is not None and hasattr(owner, "from_hashed")
     multi = dist and jax.process_count() > 1
     raw_lobpcg = None
@@ -262,12 +277,12 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             _, evals, U, iters = (run_flipped_multi(block_x0(k)) if multi
                                   else run_flipped(mv_flat, dim,
                                                    block_x0(k)))
-            _emit_end(iters, evals)
+            _emit_end(iters, evals, mem_h)
             return evals, cols_to_block(U), iters
         if X0 is None:
             X0 = np.random.default_rng(seed).standard_normal((n, k))
         _, evals, U, iters = run_flipped(raw_mv, n, X0)
-        _emit_end(iters, evals)
+        _emit_end(iters, evals, mem_h)
         return evals, U, iters
 
     # -- pair form: flat realified operator ---------------------------------
@@ -364,6 +379,6 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             f"pair-mode LOBPCG resolved only {len(kept_vals)} of {k} "
             "distinct eigenpairs (unconverged tail); re-run with more "
             "iterations or use solve.lanczos", RuntimeWarning)
-    _emit_end(iters, kept_vals)
+    _emit_end(iters, kept_vals, mem_h)
     return (np.asarray(kept_vals), np.stack(kept_vecs, axis=1),
             int(iters))

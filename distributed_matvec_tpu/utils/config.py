@@ -40,6 +40,11 @@ class RuntimeConfig:
     #   (DMT_HEALTH_EVERY): every Nth eager apply piggybacks one fused
     #   NaN/Inf-count + output-norm reduction on the result; the scalar is
     #   fetched DEFERRED so no sync is added to the hot path
+    memory_every: int = 64                 # device-memory watermark cadence
+    #   (DMT_MEMORY_EVERY): every Nth eager apply polls
+    #   device.memory_stats() into hbm_bytes_in_use/hbm_peak_bytes gauges
+    #   and a memory_watermark event; backends without stats (CPU) latch
+    #   off after the first miss (obs/memory.py)
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

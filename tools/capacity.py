@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""capacity — offline device-memory capacity planner for the matvec engines.
+
+Answers the questions the engines today answer by trial-and-OOM: how many
+bytes does each engine mode spend per basis row, what is the largest basis
+one device fits, and how many shards (or which mode) a target basis needs.
+Works entirely offline from ONE of three inputs — no device required:
+
+* ``--snapshot RUN`` — an obs run directory or ``.jsonl`` stream: the last
+  ``memory_ledger`` event's context fields (mode, n_states, n_padded /
+  shard_size, T0, num_terms, table_bytes) calibrate the model with the
+  MEASURED bytes of a real engine, and ``memory_analysis`` events supply
+  the apply executable's temp bytes.
+* ``--structure PATH`` — an engine structure sidecar (``*.structure.h5``,
+  explicit path or artifact-cache file): table shapes/dtypes are read
+  straight from the checkpoint.
+* explicit parameters — ``--n-states``, ``--num-terms``, ``--t0``
+  (+ ``--pair`` for (re, im)-f64 sectors): the purely analytic model.
+
+Model (bytes per padded basis row, one device):
+
+    ell      T0 * (4 + cf)         idx i32 + coeff (f64, or 2*f64 pair/c128)
+    compact  T0 * 4 + 20           sign-tagged i32 + inv_n f64 + n_parts 3*f32
+    fused    0 resident            structure recomputed per apply; scratch is
+                                   O(B*T) per chunk, independent of N
+    common   ~36 + 8*v*w           diag + basis row + lookup pair, plus v
+                                   live vectors of width w (x, y, solver
+                                   workspace; v = --vectors, default 3)
+
+When a snapshot/structure is given, the recorded mode's bytes/row is taken
+from the measured table bytes instead of the formula (the formula fills in
+the other modes), so the report reflects the actual split/tail packing.
+
+Usage::
+
+    python tools/capacity.py --snapshot /tmp/run --hbm-gb 16
+    python tools/capacity.py --n-states 63e6 --num-terms 36 --t0 24 \\
+        --hbm-gb 16 --target-n 1e9
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, Optional
+
+# per-row overhead shared by every mode: diag f64 + padded alpha u64 +
+# norm f64 + lookup pair (2*u32) + directory amortized (~4 B)
+COMMON_ROW_BYTES = 36
+# utilization headroom: XLA fragmentation + per-apply scratch mean a table
+# filling 100% of HBM OOMs long before that
+DEFAULT_UTILIZATION = 0.85
+
+
+def load_snapshot(path: str) -> dict:
+    """Calibration facts from an obs run: the LAST ``memory_ledger`` event
+    with engine context, plus executable ``memory_analysis`` temp bytes.
+    Run loading (rank_*/ layout, legacy files, bare .jsonl) is delegated
+    to ``obs_report.load_events`` so the sink layout lives in one place."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_report
+
+    ledger = None
+    analyses: Dict[str, dict] = {}
+    for ev in obs_report.load_events(path):
+        kind = ev.get("kind")
+        if kind == "memory_ledger" and ev.get("n_states"):
+            ledger = ev
+        elif kind == "memory_analysis":
+            analyses[str(ev.get("key") or ev.get("program"))] = ev
+    if ledger is None:
+        raise ValueError(
+            f"{path}: no memory_ledger event with engine context — run "
+            "with the obs layer on (any engine init emits one)")
+    return {"ledger": ledger, "analyses": analyses}
+
+
+def load_structure(path: str) -> dict:
+    """Table geometry straight from a structure sidecar (h5).  Handles
+    both the LocalEngine layout (``idx``/``coeff`` datasets) and the
+    DistributedEngine per-shard layout (``idx_<d>``/``coeff_<d>``)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        if "engine_structure" not in f:
+            raise ValueError(f"{path}: no /engine_structure group")
+        g = f["engine_structure"]
+        mode = str(g.attrs.get("mode", "ell"))
+        idx_keys = [k for k in g
+                    if k == "idx" or k.startswith("idx_")]
+        if not idx_keys:
+            raise ValueError(f"{path}: no idx table in the sidecar")
+        T0 = int(g.attrs.get("T0", g[idx_keys[0]].shape[0]))
+        # local: one [T0, N_pad] table; distributed: [T0, M] per shard
+        n_pad = sum(int(g[k].shape[-1]) for k in idx_keys)
+        table_bytes = sum(int(g[k].size) * g[k].dtype.itemsize for k in g)
+        coeff_keys = [k for k in g
+                      if k == "coeff" or k.startswith("coeff_")]
+        pair = cplx = False
+        if coeff_keys:
+            c = g[coeff_keys[0]]
+            pair = bool(c.ndim >= 3 and c.shape[-1] == 2)
+            cplx = c.dtype.kind == "c"
+        return {"mode": mode, "T0": T0, "n_padded": n_pad,
+                "n_states": n_pad, "table_bytes": table_bytes,
+                "pair": pair or cplx}
+
+
+def mode_bytes_per_row(T0: int, pair: bool) -> Dict[str, float]:
+    """The analytic per-row structure cost of each mode."""
+    cf = 16 if pair else 8
+    return {"ell": T0 * (4 + cf),
+            "compact": T0 * 4 + 20,
+            "fused": 0.0}
+
+
+def plan(n_states: int, num_terms: int, T0: int, pair: bool,
+         hbm_gb: float, n_devices: int, vectors: int, vec_width: int,
+         measured: Optional[dict] = None,
+         utilization: float = DEFAULT_UTILIZATION) -> dict:
+    """The capacity report: bytes/row, max basis per device and per mesh
+    for each mode, plus (optionally) measured calibration."""
+    T0 = int(T0) if T0 else int(num_terms)
+    per_mode = mode_bytes_per_row(T0, pair)
+    vec_bytes = 8 * vectors * max(vec_width, 1) * (2 if pair else 1)
+    common = COMMON_ROW_BYTES + vec_bytes
+    budget = hbm_gb * 1e9 * utilization
+    out = {"inputs": {"n_states": int(n_states), "num_terms": int(num_terms),
+                      "T0": T0, "pair": bool(pair), "hbm_gb": hbm_gb,
+                      "n_devices": int(n_devices), "vectors": vectors,
+                      "vec_width": vec_width, "utilization": utilization},
+           "modes": {}}
+    if measured:
+        out["calibration"] = measured
+        mmode = measured.get("mode")
+        n_pad = measured.get("n_padded") or measured.get("n_states")
+        if mmode in per_mode and measured.get("table_bytes") and n_pad:
+            per_mode[mmode] = measured["table_bytes"] / float(n_pad)
+            out["calibration"] = dict(
+                measured, bytes_per_row_measured=round(per_mode[mmode], 2))
+    for mode, struct_bytes in per_mode.items():
+        row = struct_bytes + common
+        rows_dev = int(budget // row)
+        out["modes"][mode] = {
+            "structure_bytes_per_row": round(struct_bytes, 2),
+            "bytes_per_row": round(row, 2),
+            "max_rows_per_device": rows_dev,
+            "max_basis_size": rows_dev * n_devices,
+            "fits_n_states": bool(n_states <= rows_dev * n_devices),
+            "devices_needed_for_n_states":
+                max(1, math.ceil(n_states / rows_dev)) if rows_dev else None,
+        }
+    return out
+
+
+def recommend(report: dict, target_n: Optional[int]) -> dict:
+    """Mode/shard recommendation for ``target_n`` (or the input basis):
+    the cheapest-per-apply mode (ell > compact > fused preference order
+    matches measured apply speed) that fits within the given mesh, else
+    the minimal shard count per mode."""
+    n = int(target_n or report["inputs"]["n_states"])
+    D = report["inputs"]["n_devices"]
+    rec = {"target_n": n}
+    options = []
+    for mode in ("ell", "compact", "fused"):
+        m = report["modes"][mode]
+        need = max(1, math.ceil(n / m["max_rows_per_device"])) \
+            if m["max_rows_per_device"] else None
+        options.append((mode, need))
+        rec[f"devices_needed_{mode}"] = need
+    fitting = [(mode, need) for mode, need in options
+               if need is not None and need <= D]
+    if fitting:
+        rec["recommended_mode"], rec["recommended_devices"] = fitting[0]
+        rec["note"] = (f"{rec['recommended_mode']} fits {n:,} rows on "
+                       f"{rec['recommended_devices']} of {D} device(s)")
+    else:
+        mode, need = min((o for o in options if o[1] is not None),
+                         key=lambda o: o[1], default=(None, None))
+        rec["recommended_mode"], rec["recommended_devices"] = mode, need
+        rec["note"] = (f"no mode fits {n:,} rows on {D} device(s); "
+                       f"{mode} needs >= {need} shards")
+    return rec
+
+
+def print_report(report: dict, rec: dict) -> None:
+    ins = report["inputs"]
+    print(f"capacity plan: N={ins['n_states']:,} T={ins['num_terms']} "
+          f"T0={ins['T0']} pair={ins['pair']} "
+          f"HBM/device={ins['hbm_gb']} GB x{ins['utilization']:.0%} "
+          f"devices={ins['n_devices']}")
+    cal = report.get("calibration")
+    if cal:
+        print(f"  calibrated from a measured {cal.get('mode')} engine: "
+              f"{cal.get('table_bytes', 0) / 1e9:.3f} GB tables"
+              + (f" = {cal['bytes_per_row_measured']} B/row"
+                 if "bytes_per_row_measured" in cal else ""))
+    print(f"  {'mode':<9} {'struct B/row':>13} {'total B/row':>12} "
+          f"{'max rows/device':>16} {'max basis (mesh)':>17}  fits N?")
+    for mode in ("ell", "compact", "fused"):
+        m = report["modes"][mode]
+        print(f"  {mode:<9} {m['structure_bytes_per_row']:>13.1f} "
+              f"{m['bytes_per_row']:>12.1f} "
+              f"{m['max_rows_per_device']:>16,} "
+              f"{m['max_basis_size']:>17,}  "
+              f"{'yes' if m['fits_n_states'] else 'no'}")
+    print(f"  recommendation: {rec['note']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    src = ap.add_argument_group("input (one of)")
+    src.add_argument("--snapshot", metavar="RUN",
+                     help="obs run dir or .jsonl with memory_ledger events")
+    src.add_argument("--structure", metavar="PATH",
+                     help="engine structure sidecar (*.structure.h5)")
+    src.add_argument("--n-states", type=float, default=None)
+    ap.add_argument("--num-terms", type=int, default=None,
+                    help="off-diagonal terms T (explicit-parameter mode)")
+    ap.add_argument("--t0", type=int, default=None,
+                    help="packed main-table width T0 (default: num-terms)")
+    ap.add_argument("--pair", action="store_true",
+                    help="(re, im)-f64 pair sector (16 B coefficients)")
+    ap.add_argument("--hbm-gb", type=float, default=16.0,
+                    help="device memory budget in GB (default 16)")
+    ap.add_argument("--utilization", type=float,
+                    default=DEFAULT_UTILIZATION,
+                    help="usable fraction of HBM (default 0.85)")
+    ap.add_argument("--n-devices", type=int, default=1)
+    ap.add_argument("--vectors", type=int, default=3,
+                    help="live full-length vectors to budget (default 3)")
+    ap.add_argument("--vec-width", type=int, default=1,
+                    help="RHS columns per vector (multi-RHS batches)")
+    ap.add_argument("--target-n", type=float, default=None,
+                    help="recommend mode/shards for this basis size")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    measured = None
+    if args.snapshot:
+        snap = load_snapshot(args.snapshot)
+        led = snap["ledger"]
+        measured = {k: led.get(k) for k in
+                    ("mode", "n_states", "n_padded", "shard_size",
+                     "n_devices", "T0", "table_bytes", "num_terms", "pair")}
+        if measured.get("n_padded") is None and led.get("shard_size"):
+            measured["n_padded"] = int(led["shard_size"]) \
+                * int(led.get("n_devices", 1))
+        n_states = int(led["n_states"])
+        num_terms = int(led.get("num_terms") or args.num_terms or 1)
+        T0 = int(led.get("T0") or args.t0 or num_terms)
+        pair = bool(led.get("pair")) or args.pair
+        n_devices = args.n_devices if args.n_devices != 1 \
+            else int(led.get("n_devices") or 1)
+    elif args.structure:
+        st = load_structure(args.structure)
+        measured = st
+        n_states = int(args.n_states or st["n_states"])
+        num_terms = int(args.num_terms or st["T0"])
+        T0 = int(args.t0 or st["T0"])
+        pair = st["pair"] or args.pair
+        n_devices = args.n_devices
+    else:
+        if args.n_states is None or args.num_terms is None:
+            ap.error("pass --snapshot, --structure, or both "
+                     "--n-states and --num-terms")
+        n_states = int(args.n_states)
+        num_terms = int(args.num_terms)
+        T0 = int(args.t0 or num_terms)
+        pair = args.pair
+        n_devices = args.n_devices
+
+    report = plan(n_states, num_terms, T0, pair, args.hbm_gb, n_devices,
+                  args.vectors, args.vec_width, measured=measured,
+                  utilization=args.utilization)
+    rec = recommend(report, int(args.target_n) if args.target_n else None)
+    if args.json:
+        print(json.dumps({"report": report, "recommendation": rec},
+                         indent=1, sort_keys=True))
+    else:
+        print_report(report, rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

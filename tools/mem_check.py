@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""mem_check — the ``make mem-check`` gate for memory observability
+(obs/memory.py).
+
+Runs the chain-16 smoke config with the obs layer on and asserts the
+memory pillar end to end:
+
+1. **Ledger parity**: the ledger's registered structure bytes equal the
+   engine's ``ell_nbytes`` EXACTLY (both enumerate the live table leaves;
+   a drift means a table was added without registration).
+2. **Analysis reconciliation**: the apply executable's
+   ``memory_analysis()`` argument bytes equal the ledger's accounting of
+   what the apply consumes (x + structure tables + diag) within
+   ``--tolerance`` (default 5% — alignment/padding slack).
+3. **Stream completeness**: the JSONL run contains ``memory_ledger`` and
+   ``memory_analysis`` events, and ``tools/capacity.py`` produces a
+   max-basis-size estimate from that snapshot alone.
+4. **Cleanliness**: a healthy run emits ZERO OOM/critical memory events.
+
+Prints one JSON line and exits 0/1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="max relative ledger-vs-analysis mismatch "
+                         "(default 0.05)")
+    args = ap.parse_args(argv)
+
+    # the gate must own its knobs (same contract as health_check)
+    for knob in ("DMT_OBS", "DMT_OBS_DIR", "DMT_MEMORY_EVERY"):
+        os.environ.pop(knob, None)
+    run_dir = tempfile.mkdtemp(prefix="dmt_mem_check_")
+    os.environ["DMT_OBS_DIR"] = run_dir
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    basis = SpinBasis(number_spins=16, hamming_weight=8)
+    basis.build()
+    op = heisenberg_from_edges(basis, chain_edges(16))
+    eng = LocalEngine(op, mode="ell")
+    n = basis.number_states
+    x = np.random.default_rng(0).standard_normal(n)
+    xj = jax.numpy.asarray(x / np.linalg.norm(x))
+    for _ in range(3):
+        y = eng.matvec(xj)
+    jax.block_until_ready(y)
+
+    result = {"config": "heisenberg_chain_16", "n_states": n,
+              "tolerance": args.tolerance, "run_dir": run_dir}
+    failures = []
+
+    # 1. ledger parity with ell_nbytes (exact)
+    table_bytes = int(eng.ell_nbytes)
+    ledger_struct = obs.ledger_total(
+        f"engine/{eng._mem_instance}/structure")
+    result["table_bytes"] = table_bytes
+    result["ledger_structure_bytes"] = ledger_struct
+    if ledger_struct != table_bytes:
+        failures.append(f"ledger structure bytes {ledger_struct} != "
+                        f"ell_nbytes {table_bytes}")
+
+    # 2. compiled apply analysis reconciles with the ledger's accounting
+    ana = eng.apply_memory_analysis(xj)
+    if ana is None:
+        failures.append("no apply memory_analysis on this backend")
+    else:
+        expect_args = int(xj.nbytes) + table_bytes + int(eng._diag.nbytes)
+        rel = abs(ana["argument_bytes"] - expect_args) \
+            / max(ana["argument_bytes"], 1)
+        result.update(analysis_argument_bytes=ana["argument_bytes"],
+                      ledger_expected_bytes=expect_args,
+                      reconcile_rel_err=round(rel, 6))
+        if rel > args.tolerance:
+            failures.append(
+                f"apply argument bytes {ana['argument_bytes']} vs ledger "
+                f"{expect_args}: {rel:.1%} > {args.tolerance:.0%}")
+
+    # 3. the JSONL stream carries the events and the planner reads them
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
+    kinds = {ev.get("kind") for ev in obs.events()}
+    for needed in ("memory_ledger", "memory_analysis"):
+        if needed not in kinds:
+            failures.append(f"no {needed} event in the obs stream")
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "capacity", os.path.join(REPO, "tools", "capacity.py"))
+        cap = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cap)
+        snap = cap.load_snapshot(run_dir)
+        led = snap["ledger"]
+        report = cap.plan(int(led["n_states"]), int(led["num_terms"]),
+                          int(led["T0"]), bool(led["pair"]),
+                          hbm_gb=16.0, n_devices=1, vectors=3, vec_width=1,
+                          measured={k: led.get(k) for k in
+                                    ("mode", "n_states", "n_padded", "T0",
+                                     "table_bytes")})
+        max_basis = report["modes"]["ell"]["max_basis_size"]
+        result["capacity_max_basis_ell"] = int(max_basis)
+        if not max_basis > n:
+            failures.append(f"capacity plan nonsensical: max ell basis "
+                            f"{max_basis} <= measured N {n}")
+    except Exception as e:
+        failures.append(f"capacity planner failed on the snapshot: {e!r}")
+
+    # 4. a healthy run has zero OOM/critical memory events
+    ooms = obs.events("memory_report")
+    snap_counters = obs.snapshot()["counters"]
+    oom_count = int(snap_counters.get("oom_events", 0)) + len(ooms)
+    result["oom_events"] = oom_count
+    if oom_count:
+        failures.append(f"{oom_count} OOM memory event(s) on a healthy run")
+
+    result["ok"] = not failures
+    print(json.dumps(result))
+    for f in failures:
+        print(f"[mem_check] FAIL: {f}", file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

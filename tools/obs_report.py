@@ -18,7 +18,9 @@ Subcommands::
         One run → engine-init split table (structure/compile/transfer/diag),
         artifact-cache hit rates + AOT executable-cache reuse + transfer
         volume from the final metrics snapshot, numerical-health counters
-        (exchange overflow/invalid, nonfinite outputs) + events, per-config
+        (exchange overflow/invalid, nonfinite outputs) + events, a memory
+        section (ledger top allocations + totals per rank, peak HBM
+        watermarks, executable memory analyses, OOM reports), per-config
         bench metrics, and solver convergence traces (iteration → Ritz
         value/residual — ready-to-plot data).
 
@@ -31,19 +33,23 @@ Subcommands::
         ``(ts_adj, rank, seq)`` (within-rank ``seq`` order is monotonic and
         trusted; wall clocks across hosts are not).
 
-    report RUN [--ranks] [--json]
+    report RUN [--ranks] [--memory] [--json]
         Cross-rank skew report: estimated clock offsets, straggler
         attribution per apply (the rank whose aligned ``matvec_apply``
         lands last; excess = max − median), and with ``--ranks`` the
         per-rank table — events, survivor states, bytes exchanged,
-        plan-build wall, double-buffer stalls, mean time-at-barrier.
+        plan-build wall, double-buffer stalls, per-rank peak HBM, mean
+        time-at-barrier.  ``--memory`` appends the memory section
+        (ledger / watermarks / executables / OOM reports).
 
     diff BASELINE NEW [--threshold 0.2] [--metric device_ms ...]
-                      [--config NAME ...] [--all-metrics]
+                      [--config NAME ...] [--memory] [--all-metrics]
         Two runs → per-config relative change of every comparable numeric
         metric; exits 1 when any *gated* metric regressed beyond the
         threshold (default gate: device_ms; direction-aware — ms/seconds
-        up is a regression, iters-per-second down is).  This is the CI
+        up is a regression, iters-per-second down is).  ``--memory`` adds
+        the memory gate (table_bytes, executable temp/peak bytes,
+        watermark peak — growth is the regression).  This is the CI
         perf gate `make obs-check` runs against the recorded
         BENCH_DETAIL.json.
 
@@ -70,6 +76,11 @@ from typing import Dict, List, Optional
 _HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate")
 
 _DEFAULT_GATE = ("device_ms",)
+
+# the memory-regression gate (`diff --memory`): all cost-like, so the
+# direction rule above already reads growth as the regression
+_MEMORY_GATE = ("table_bytes", "executable_temp_bytes",
+                "executable_peak_bytes", "peak_hbm_bytes")
 
 
 def _is_higher_better(metric: str) -> bool:
@@ -206,6 +217,49 @@ def _cache_rates(snap: dict) -> dict:
     return {"caches": rates, **bytes_io, "retrace_count": retrace}
 
 
+def memory_summary(events: List[dict], top_n: int = 8) -> dict:
+    """Memory observability digest of one run: the LAST ``memory_ledger``
+    snapshot per rank (top-N allocations by bytes), max watermark peak per
+    rank, executable analyses (one per compiled specialization, last
+    wins), and any OOM ``memory_report`` events."""
+    ledgers: Dict[int, dict] = {}
+    peaks: Dict[int, int] = {}
+    analyses: Dict[str, dict] = {}
+    ooms = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "memory_ledger":
+            ledgers[_rank_of(ev)] = ev
+        elif kind == "memory_watermark":
+            r = _rank_of(ev)
+            peaks[r] = max(peaks.get(r, 0), int(ev.get("peak_bytes") or 0))
+        elif kind == "memory_analysis":
+            analyses[str(ev.get("key") or ev.get("program"))] = {
+                k: ev.get(k) for k in
+                ("program", "argument_bytes", "output_bytes", "temp_bytes",
+                 "generated_code_bytes", "peak_estimate_bytes")}
+        elif kind == "memory_report":
+            ooms.append({k: ev.get(k) for k in
+                         ("rank", "context", "ledger_total_bytes",
+                          "error", "remediation") if k in ev})
+    top: Dict[int, list] = {}
+    totals: Dict[int, int] = {}
+    contexts: Dict[int, dict] = {}
+    for r, ev in ledgers.items():
+        entries = ev.get("entries") or {}
+        rows = sorted(((p, int(e.get("bytes", 0)))
+                       for p, e in entries.items()),
+                      key=lambda pe: -pe[1])
+        top[r] = [{"path": p, "bytes": b} for p, b in rows[:top_n]]
+        totals[r] = int(ev.get("total_bytes") or 0)
+        contexts[r] = {k: ev.get(k) for k in
+                       ("context", "engine", "mode", "n_states", "T0",
+                        "table_bytes") if k in ev}
+    return {"ledger_total_bytes": totals, "top_allocations": top,
+            "ledger_context": contexts, "peak_hbm_bytes": peaks,
+            "executables": analyses, "oom_events": ooms}
+
+
 def run_summary(events: List[dict]) -> dict:
     """The machine-readable summary ``summarize`` renders."""
     inits = [{k: ev.get(k) for k in
@@ -263,6 +317,7 @@ def run_summary(events: List[dict]) -> dict:
             "cache": cache,
             "health": {"counters": health_counters,
                        "events": health_events},
+            "memory": memory_summary(events),
             "bench": bench_metrics(events),
             "solvers": solvers}
 
@@ -314,6 +369,10 @@ def print_summary(s: dict) -> None:
                 print(f"    {ev.get('kind')}: {detail}")
         else:
             print("  no health events (clean run)")
+    mem = s.get("memory") or {}
+    if any(mem.get(k) for k in ("top_allocations", "peak_hbm_bytes",
+                                "executables", "oom_events")):
+        print_memory_section(mem)
     if s["bench"]:
         print("\nbench results:")
         for cfg, m in sorted(s["bench"].items()):
@@ -335,6 +394,58 @@ def print_summary(s: dict) -> None:
                 res = max(t.get("residual") or [float("nan")])
                 print(f"  {str(t.get('iter')):<6} {str(t.get('basis_size')):<8}"
                       f" {ritz:<18.12g} {res:.3e}")
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "-"
+    b = float(b)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(b) < 1024 or unit == "GB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} GB"
+
+
+def print_memory_section(mem: dict) -> None:
+    """Render the :func:`memory_summary` digest: ledger top allocations
+    and totals per rank, peak HBM watermarks, executable analyses sorted
+    by temp bytes, OOM reports (the ``summarize`` memory section and the
+    body of ``report --memory``)."""
+    print("\nmemory (device-memory ledger / watermarks / executables):")
+    totals = mem.get("ledger_total_bytes") or {}
+    peaks = mem.get("peak_hbm_bytes") or {}
+    for r in sorted(set(totals) | set(peaks)):
+        ctx = (mem.get("ledger_context") or {}).get(r) or {}
+        note = " ".join(f"{k}={v}" for k, v in ctx.items()
+                        if k in ("mode", "n_states", "T0"))
+        print(f"  rank {r}: ledger {_fmt_bytes(totals.get(r))} resident, "
+              f"peak HBM {_fmt_bytes(peaks.get(r))}"
+              + (f"  ({note})" if note else ""))
+    for r, rows in sorted((mem.get("top_allocations") or {}).items()):
+        print(f"  top allocations (rank {r}):")
+        for row in rows:
+            print(f"    {row['path']:<52} {_fmt_bytes(row['bytes']):>12}")
+    exes = mem.get("executables") or {}
+    if exes:
+        print("  compiled executables (memory_analysis; by temp bytes):")
+        rows = sorted(exes.items(),
+                      key=lambda kv: -(kv[1].get("temp_bytes") or 0))
+        for key, a in rows[:10]:
+            print(f"    {a.get('program', key):<36} "
+                  f"args={_fmt_bytes(a.get('argument_bytes')):>10} "
+                  f"out={_fmt_bytes(a.get('output_bytes')):>10} "
+                  f"temp={_fmt_bytes(a.get('temp_bytes')):>10}")
+    ooms = mem.get("oom_events") or []
+    if ooms:
+        print(f"  {len(ooms)} OOM memory_report event(s):")
+        for ev in ooms[:5]:
+            print(f"    rank {ev.get('rank')} context={ev.get('context')} "
+                  f"ledger={_fmt_bytes(ev.get('ledger_total_bytes'))}")
+            for fix in (ev.get("remediation") or [])[:3]:
+                print(f"      -> {fix}")
+    else:
+        print("  no OOM events (healthy run)")
 
 
 # ---------------------------------------------------------------------------
@@ -495,6 +606,8 @@ def rank_table(events: List[dict],
         applies = [ev for ev in mine if ev.get("kind") == "matvec_apply"]
         inits = [ev for ev in mine if ev.get("kind") == "engine_init"]
         snaps = [ev for ev in mine if ev.get("kind") == "metrics_snapshot"]
+        peaks = [int(ev.get("peak_bytes") or 0) for ev in mine
+                 if ev.get("kind") == "memory_watermark"]
         db = None
         if snaps:
             hists = snaps[-1].get("metrics", {}).get("histograms", {})
@@ -514,6 +627,7 @@ def rank_table(events: List[dict],
             "bytes_exchanged": int(sum(
                 int(ev.get("bytes") or 0) for ev in applies)),
             "db_stall_ms": round(db, 3) if db is not None else None,
+            "peak_hbm": max(peaks) if peaks else None,
             "skew_s": round(offsets.get(r, 0.0), 6),
             "barrier_wait_ms": st.get("barrier_wait_ms"),
             "straggled": st.get("straggled"),
@@ -529,8 +643,8 @@ def print_rank_report(table: dict, show_ranks: bool) -> None:
     strag = table["straggler"]
     if show_ranks:
         cols = ("rank", "events", "states", "applies", "bytes_exchanged",
-                "plan_wall_s", "db_stall_ms", "skew_s", "barrier_wait_ms",
-                "straggled")
+                "plan_wall_s", "db_stall_ms", "peak_hbm", "skew_s",
+                "barrier_wait_ms", "straggled")
         widths = {c: max(len(c), 12) for c in cols}
         widths["rank"] = widths["events"] = widths["applies"] = 7
         print("  ".join(f"{c:>{widths[c]}}" for c in cols))
@@ -758,7 +872,11 @@ def main(argv=None) -> int:
     p.add_argument("--ranks", action="store_true",
                    help="include the per-rank skew table (events, survivor "
                         "states, bytes exchanged, plan wall, stalls, "
-                        "time-at-barrier)")
+                        "per-rank peak HBM, time-at-barrier)")
+    p.add_argument("--memory", action="store_true",
+                   help="include the memory section (ledger top "
+                        "allocations, watermark peaks, executable "
+                        "analyses, OOM reports)")
     p.add_argument("--json", action="store_true",
                    help="print the machine-readable table dict")
 
@@ -772,6 +890,10 @@ def main(argv=None) -> int:
                    help="gate on this metric (repeatable; default device_ms)")
     p.add_argument("--config", action="append", default=None,
                    help="only configs whose name contains this substring")
+    p.add_argument("--memory", action="store_true",
+                   help="also gate on memory regressions (table_bytes, "
+                        "executable temp/peak bytes, watermark peak — all "
+                        "direction-aware: growth is the regression)")
     p.add_argument("--all-metrics", action="store_true",
                    help="print every common metric, not just gated/changed")
 
@@ -810,17 +932,24 @@ def main(argv=None) -> int:
     if args.cmd == "report":
         events = load_events(args.run)
         table = rank_table(events)
+        if args.memory:
+            table["memory"] = memory_summary(events)
         if args.json:
             print(json.dumps(table, indent=1, sort_keys=True))
         else:
             print_rank_report(table, show_ranks=args.ranks)
+            if args.memory:
+                print_memory_section(table["memory"])
         return 0
 
     if args.cmd == "diff":
         base = bench_metrics(load_events(args.base))
         new = bench_metrics(load_events(args.new))
+        gate = list(args.metric) if args.metric else list(_DEFAULT_GATE)
+        if args.memory:
+            gate += [m for m in _MEMORY_GATE if m not in gate]
         rows, regressions, common = diff_runs(
-            base, new, args.threshold, args.metric, args.config)
+            base, new, args.threshold, gate, args.config)
         print_diff(rows, regressions, common, args.threshold,
                    args.all_metrics)
         if not common:
