@@ -12,13 +12,14 @@ OBS_THRESHOLD ?= 0.2
 HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
-	obs-check health-check mem-check clean
+	obs-check health-check mem-check stream-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
 	$(MAKE) obs-check
 	$(MAKE) health-check
 	$(MAKE) mem-check
+	$(MAKE) stream-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -76,6 +77,15 @@ obs-check:
 # OOM/critical memory events.
 mem-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/mem_check.py
+
+# Streamed-mode gate (tools/stream_check.py): bit-identity of streamed vs
+# fused applies (single + batch + <x,Hx>), exchange counters preserved, a
+# direction-aware obs_report diff gate on the steady-state (second+)
+# streamed speedup (retried — timing noise vs genuine regression resolves
+# by attempt 3), DMT_ARTIFACT_CACHE=off pure host-RAM streaming with zero
+# disk writes, and the plan sidecar save/restore round-trip.
+stream-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/stream_check.py
 
 # Numerical-health gate (tools/health_check.py): chain-16 smoke applies
 # with probes on vs off in ONE process (same warm engine — cross-process

@@ -66,15 +66,19 @@ def main(argv=None):
                          "representative array is never built; the solve "
                          "stays in hashed space and eigenvectors are saved "
                          "per shard (vector_shards/eigenvector_<i>)")
-    ap.add_argument("--mode", choices=("ell", "compact", "fused"),
+    ap.add_argument("--mode", choices=("ell", "compact", "streamed",
+                                       "fused"),
                     default=None,
                     help="engine mode: precomputed structure (ell, the "
                          "default), 4 B/entry for isotropic real sectors "
-                         "(compact), or recompute-on-the-fly (fused — the "
-                         "default with --shards; plan builds also work "
-                         "shard-native, streaming peer shards from the "
-                         "file, and are worth their one-time cost for "
-                         "long solves)")
+                         "(compact), the structure resolved once into a "
+                         "host-RAM plan streamed per apply (streamed — "
+                         "fused-level device memory, no per-apply orbit "
+                         "scan; solved via the eager block-Lanczos), or "
+                         "recompute-on-the-fly (fused — the default with "
+                         "--shards; plan builds also work shard-native, "
+                         "streaming peer shards from the file, and are "
+                         "worth their one-time cost for long solves)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--solver-checkpoint", default=None, metavar="CKPT_H5",
@@ -172,13 +176,29 @@ def main(argv=None):
         print(f"basis: N={n} states "
               f"({'restored from' if restored else 'checkpointed to'} {out})")
 
+    if args.mode == "streamed":
+        # fail BEFORE the engine pays the plan-resolution cost: pair-form
+        # sectors (complex characters on a TPU mesh) have no in-tree
+        # streamed solver — lanczos() cannot trace a streamed engine and
+        # lanczos_block() has no J-aware reorthogonalization
+        from distributed_matvec_tpu.parallel.engine import use_pair_complex
+        if (not cfg.hamiltonian.effective_is_real) and use_pair_complex():
+            print("--mode streamed does not support pair-form complex "
+                  "sectors (no streamed-compatible solver handles the "
+                  "J-aware recurrence); use --mode ell/fused, or run the "
+                  "sector native-c128 on CPU", file=sys.stderr)
+            return 2
+
     with timer.scope("engine"):
         if args.shards:
             pass                              # engine built above
-        elif args.devices and args.devices > 1:
+        elif (args.devices and args.devices > 1) or args.mode == "streamed":
             from distributed_matvec_tpu.parallel.distributed import (
                 DistributedEngine)
-            eng = DistributedEngine(cfg.hamiltonian, n_devices=args.devices,
+            # streamed lives on DistributedEngine; without --devices it
+            # runs the documented single-device form (n_devices=1)
+            eng = DistributedEngine(cfg.hamiltonian,
+                                    n_devices=args.devices or 1,
                                     mode=args.mode)
             v0 = eng.random_hashed(seed=42)
         else:
@@ -212,6 +232,25 @@ def main(argv=None):
                 float(np.linalg.norm(mv_block(v) - w * np.asarray(v)))
                 for w, v in zip(evals, evecs)])
             niter = iters
+        elif args.mode == "streamed":
+            # a streamed engine cannot be traced into the single-program
+            # Lanczos block runner — drive it with the eager block solver
+            # (each k-column block streams the plan once)
+            from distributed_matvec_tpu.solve import lanczos_block
+            if args.solver_checkpoint:
+                print("warning: --solver-checkpoint applies to the "
+                      "single-vector Lanczos only; streamed-mode block "
+                      "solves are not checkpointed", file=sys.stderr)
+            res = lanczos_block(eng.matvec, k=args.num_evals,
+                                tol=args.tol, max_iters=args.max_iters,
+                                seed=42,
+                                compute_eigenvectors=not
+                                args.no_eigenvectors)
+            evals, residuals, niter = (res.eigenvalues, res.residual_norms,
+                                       res.num_iters)
+            evecs = res.eigenvectors
+            if not res.converged:
+                print("warning: solver did not converge", file=sys.stderr)
         else:
             res = lanczos(eng.matvec, n=None if v0 is not None else n,
                           v0=v0, k=args.num_evals, tol=args.tol,

@@ -260,12 +260,80 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     return out
 
 
+def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1):
+    """Fused vs streamed DistributedEngine on one config.
+
+    Records what the cold-apply numbers hide: ``plan_build_s`` and
+    ``plan_bytes`` (the one-time structure resolution), per-mode
+    ``*_first_apply_ms`` and ``*_steady_apply_ms`` (second-and-later
+    applies — where the streamed amortization lives), the
+    ``plan_stream_stall_ms`` H2D wait, and the steady-state speedup the
+    stream-check gate asserts.  Bit-identity of the streamed result
+    against fused rides along as a hard check."""
+    import jax
+
+    from distributed_matvec_tpu.obs.metrics import histogram as _hist
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.utils.artifacts import make_or_restore_basis
+
+    n_sites = basis_args["number_spins"]
+    obs.emit("bench_config_start", config=name)
+    _progress(f"{name}: stream bench, building basis")
+    op = _build_op(basis_args, n_sites, edges)
+    make_or_restore_basis(op.basis)
+    n = op.basis.number_states
+    out = {"config": name, "n_states": n}
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(n)
+    x /= np.linalg.norm(x)
+    y_ref = None
+    for mode in ("fused", "streamed"):
+        _progress(f"{name}: {mode} engine")
+        t0 = time.perf_counter()
+        eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+        init_s = time.perf_counter() - t0
+        xh = eng.to_hashed(x)
+        stall = _hist("plan_stream_stall_ms")
+        stall_sum0, stall_n0 = stall.sum, stall.count
+        t0 = time.perf_counter()
+        yh = jax.block_until_ready(eng.matvec(xh))
+        first_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            yh = eng.matvec(xh)
+        jax.block_until_ready(yh)
+        steady_ms = (time.perf_counter() - t0) / repeats * 1e3
+        out[f"{mode}_init_s"] = round(init_s, 3)
+        out[f"{mode}_first_apply_ms"] = round(first_ms, 3)
+        out[f"{mode}_steady_apply_ms"] = round(steady_ms, 3)
+        if mode == "fused":
+            y_ref = np.asarray(yh)
+        else:
+            out["stream_bit_identical"] = bool(
+                np.array_equal(y_ref, np.asarray(yh)))
+            out["plan_bytes"] = int(eng.plan_bytes)
+            out["plan_build_s"] = round(
+                eng.timer.scope_total("build_plan"), 3)
+            napp = max(stall.count - stall_n0, 1)
+            out["plan_stream_stall_ms"] = round(
+                (stall.sum - stall_sum0) / napp, 4)
+        _progress(f"{name}: {mode} steady {steady_ms:.2f} ms/apply")
+    out["stream_steady_speedup"] = round(
+        out["fused_steady_apply_ms"]
+        / max(out["streamed_steady_apply_ms"], 1e-9), 2)
+    obs.emit("bench_result", **out)
+    return out
+
+
 CHAIN_32_SYMM = dict(number_spins=32, hamming_weight=16, spin_inversion=1,
                      symmetries=[([*range(1, 32), 0], 0),
                                  ([*reversed(range(32))], 0)])
 CHAIN_24_SYMM = dict(number_spins=24, hamming_weight=12, spin_inversion=1,
                      symmetries=[([*range(1, 24), 0], 0),
                                  ([*reversed(range(24))], 0)])
+CHAIN_16_SYMM = dict(number_spins=16, hamming_weight=8, spin_inversion=1,
+                     symmetries=[([*range(1, 16), 0], 0),
+                                 ([*reversed(range(16))], 0)])
 
 
 def _probe_device(timeout_s: int = 180) -> bool:
@@ -358,6 +426,11 @@ def main():
         main_cfg = _bench_config(
             "heisenberg_chain_16", dict(number_spins=16, hamming_weight=8),
             repeats=50, host_repeats=1, solver_iters=20)
+        try:
+            detail["stream_chain_16_symm"] = _bench_stream(
+                "stream_chain_16_symm", CHAIN_16_SYMM, repeats=10)
+        except Exception as e:
+            detail["stream_chain_16_symm"] = {"error": repr(e)}
     elif args.cpu_fallback:
         # Dead-chip round: run every config that is CPU-feasible (same
         # config keys as the recorded full run, minus chain_32_symm whose
@@ -387,6 +460,11 @@ def main():
                                             **kw)
             except Exception as e:
                 detail[key] = {"error": repr(e)}
+        try:
+            detail["stream_chain_24_symm"] = _bench_stream(
+                "stream_chain_24_symm", CHAIN_24_SYMM, repeats=5)
+        except Exception as e:
+            detail["stream_chain_24_symm"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_24_symm", CHAIN_24_SYMM,
@@ -424,6 +502,11 @@ def main():
                 edges=square_edges(4, 4))
         except Exception as e:
             detail["square_4x4"] = {"error": repr(e)}
+        try:
+            detail["stream_chain_24_symm"] = _bench_stream(
+                "stream_chain_24_symm", CHAIN_24_SYMM, repeats=5)
+        except Exception as e:
+            detail["stream_chain_24_symm"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_32_symm", CHAIN_32_SYMM,

@@ -264,13 +264,19 @@ def omega_estimate(alph: np.ndarray, bet: np.ndarray, lo: int, m: int,
 
 
 def health_event_count() -> int:
-    """Total ``health`` + ``solver_health`` events in this process's
-    in-memory buffer, after draining pending probe fetches — the one
-    shared tally harnesses (bench, the health-check gate) diff
-    before/after a run, so the kind list cannot drift between them."""
+    """Total warn/critical ``health`` + ``solver_health`` events in this
+    process's in-memory buffer, after draining pending probe fetches —
+    the one shared tally harnesses (bench, the health-check gate) diff
+    before/after a run, so the kind list cannot drift between them.
+    ``info``-level events (e.g. the selective-reorthogonalization
+    fallback marker, which fires on perfectly healthy converging solves)
+    are deliberately excluded: the gate's contract is "zero PROBLEMS",
+    not "zero telemetry"."""
     drain()
     from .events import events
-    return len(events("health")) + len(events("solver_health"))
+    return sum(1 for kind in ("health", "solver_health")
+               for e in events(kind)
+               if e.get("level") in ("warn", "critical"))
 
 
 def reset_health() -> None:

@@ -63,6 +63,7 @@ __all__ = [
     "ledger_entries",
     "ledger_tree",
     "ledger_total",
+    "host_rss_bytes",
     "emit_ledger",
     "next_instance",
     "sample_watermark",
@@ -198,14 +199,34 @@ def ledger_tree() -> dict:
     return root
 
 
-def ledger_total(prefix: Optional[str] = None) -> int:
-    """Total live bytes, optionally restricted to paths under ``prefix``."""
+def ledger_total(prefix: Optional[str] = None,
+                 device: Optional[str] = None) -> int:
+    """Total live bytes, optionally restricted to paths under ``prefix``
+    and/or to one ``device`` class (``"host"`` for host-RAM entries like
+    the streamed engine's plan; ``"device"`` for HBM-resident arrays)."""
     total = 0
     for path, ent in ledger_entries().items():
-        if prefix is None or path == prefix \
-                or path.startswith(prefix + "/"):
-            total += ent["bytes"]
+        if prefix is not None and path != prefix \
+                and not path.startswith(prefix + "/"):
+            continue
+        if device is not None and ent.get("device") != device:
+            continue
+        total += ent["bytes"]
     return total
+
+
+def host_rss_bytes() -> int:
+    """This process's current resident-set size in bytes (0 when the
+    platform exposes none) — the host-RAM watermark companion to the
+    device ``memory_stats()`` sampler, read by the streamed engine's plan
+    accounting and the OOM forensics report.  Proc-based (no psutil
+    dependency); soft-fails to 0 anywhere /proc is absent."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
 
 
 def emit_ledger(context: str, **fields) -> Optional[dict]:
@@ -401,13 +422,15 @@ class MemoryReport:
     watermark: Optional[dict]
     executables: Dict[str, dict]
     remediation: List[str]
+    host_rss_bytes: int = 0
 
     def to_dict(self) -> dict:
         return {"context": self.context, "ledger": self.ledger,
                 "ledger_total_bytes": self.ledger_total_bytes,
                 "watermark": self.watermark,
                 "executables": self.executables,
-                "remediation": self.remediation}
+                "remediation": self.remediation,
+                "host_rss_bytes": self.host_rss_bytes}
 
 
 _OOM_MARKERS = ("resource_exhausted", "out of memory", "out-of-memory")
@@ -433,6 +456,10 @@ def remediation(context: dict) -> List[str]:
     phase = str(context.get("phase", ""))
     out = []
     if mode in ("ell", "compact"):
+        out.append(
+            "switch to mode='streamed' (DistributedEngine): the routing "
+            "plan lives in host RAM and streams per apply — fused-level "
+            "device memory at near-plan-bandwidth apply speed")
         out.append(
             "switch to mode='fused' (recomputes structure per apply: "
             "O(B*T) scratch instead of resident O(N*T0) tables)")
@@ -474,6 +501,7 @@ def build_memory_report(**context) -> MemoryReport:
         watermark=last_watermark(),
         executables=executable_analyses(),
         remediation=remediation(context),
+        host_rss_bytes=host_rss_bytes(),
     )
 
 

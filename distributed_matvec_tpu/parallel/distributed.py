@@ -42,6 +42,18 @@ Three modes, mirroring :class:`~.engine.LocalEngine`:
   are *counted* and surfaced (the reference instead blocks on a full buffer);
   the first apply checks the counter and fails loudly.
 
+* ``"streamed"`` — the fused exchange with the structure resolved ONCE: a
+  build pass runs the fused-class per-chunk program (orbit scan + routing +
+  receive-side lookup) a single time, spills the resulting plan — per
+  (row, term) routed exchange slot + coefficient, plus the per-device
+  receive layout — to host RAM (optional artifact-cache disk tier), and
+  every subsequent apply double-buffers those chunks H2D and skips
+  ``state_info`` entirely: the N·T·|G| scan term becomes a bandwidth-bound
+  stream, and the ``all_to_all`` carries amplitudes only.  Device-resident
+  memory matches fused (no tables); steady-state applies run at plan-stream
+  bandwidth.  Bit-identical to ``fused`` for single vectors and k ≤ 4
+  batches (same chunking, same bucket math, same accumulation order).
+
 Both modes keep the reference's invariant check: a nonzero amplitude routed
 to a state absent from the basis raises (DistributedMatrixVector.chpl:113-118).
 
@@ -94,6 +106,44 @@ def _pspec(ndim: int) -> P:
     return P(SHARD_AXIS, *([None] * (ndim - 1)))
 
 
+def _close_plan_files(files: dict) -> None:
+    """Close a streamed engine's lazily-opened disk-tier sidecar handles
+    (weakref.finalize target — a long-lived process constructing many
+    disk-tier engines must not accumulate open descriptors)."""
+    for f in files.values():
+        try:
+            f.close()
+        except Exception:
+            pass
+    files.clear()
+
+
+def _bucket_positions(key: jax.Array, D: int) -> jax.Array:
+    """Rank of each entry within its ``key`` bucket (keys in [0, D]; D marks
+    dead entries).  Shared by the fused apply and the streamed plan build so
+    their routing — and therefore the exchange layout — is bit-identical.
+
+    For small meshes the key takes only D+1 values, so a one-hot cumsum
+    gives the rank in one O(N·D) vector pass — measured 16% faster than the
+    stable argsort it replaces at chain_32_symm, and bit-identical (cumsum
+    rank = stable-sort position).  The O(N·D) intermediates grow with mesh
+    size, so large meshes keep the O(N log N) sort (the crossover is near
+    the sizes where N·D·4B per chunk stops fitting in cache)."""
+    if D <= 16:
+        onehot = (key[:, None] == jnp.arange(D)[None, :])
+        pos_all = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+        return jnp.take_along_axis(
+            pos_all, jnp.clip(key, 0, D - 1)[:, None], 1)[:, 0]
+    order = jnp.argsort(key, stable=True)
+    key_s = key[order]
+    starts = jnp.searchsorted(key_s, jnp.arange(D + 1))
+    pos_s = (jnp.arange(key_s.shape[0])
+             - starts[jnp.clip(key_s, 0, D)])
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0]))
+    return pos_s[inv]
+
+
 class DistributedEngine:
     """Hash-sharded distributed matvec over a ``jax.sharding.Mesh``.
 
@@ -124,7 +174,7 @@ class DistributedEngine:
         self.basis_restored = False
         cfg = get_config()
         mode = mode or cfg.matvec_mode
-        if mode not in ("ell", "compact", "fused"):
+        if mode not in ("ell", "compact", "fused", "streamed"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:
             raise ValueError("the engine requires a Hermitian operator")
@@ -411,7 +461,40 @@ class DistributedEngine:
             self._lk_pair = self._assemble_sharded(pair_rows)
             self._lk_dir = self._assemble_sharded(dir_rows)
             self._capacity = self._fused_capacity()
-            self._matvec = self._make_fused_matvec()
+            if mode == "fused":
+                self._matvec = self._make_fused_matvec()
+            else:
+                # streamed: resolve the fused-class structure ONCE (per
+                # construction or artifact-cache restore) into a host-RAM
+                # plan, then stream it back per apply — the orbit scan and
+                # routing math never run again
+                stream_cache = self._resolve_structure_cache(structure_cache)
+                self.structure_restored = agree_restored(
+                    self._try_load_stream_plan(stream_cache))
+                record_structure_cache(self.structure_restored,
+                                       stream_cache is not None)
+                if not self.structure_restored:
+                    with self.timer.scope("build_plan"), \
+                            annotate("engine_init/build_plan"):
+                        try:
+                            self._build_stream_plan(row_provider)
+                        except Exception as e:
+                            if not obs_memory.is_resource_exhausted(e):
+                                getattr(self, "_plan_stage_h",
+                                        obs_memory.NULL_HANDLE).release()
+                            oom_reraise(e, engine="distributed", mode=mode,
+                                        phase="init",
+                                        n_states=int(self.n_states))
+                    self._save_stream_plan(stream_cache, soft=soft_save)
+                self._register_stream_plan()
+                import weakref
+                weakref.finalize(self, _close_plan_files, self._plan_files)
+                self._matvec = self._make_streamed_matvec()
+                # overflow/invalid are structural and validated at plan time
+                # (build or restore) — applies revalidate nothing
+                self._last_program_key = "streamed"
+                self._last_capacity = self._capacity
+                self._checked.add("streamed")
         # per-rank shard census — the survivor-count column of the
         # cross-rank skew table (`obs_report report --ranks`): how many
         # basis states this rank's addressable shards actually carry
@@ -440,12 +523,14 @@ class DistributedEngine:
         hashed space.  ``to_hashed``/``from_hashed`` still work for
         moderate sizes by materializing the global layout lazily.
 
-        All three modes work shard-native: the plan builds stream peer
-        shards from the file one at a time (never all host-side), and
-        ``structure_cache`` checkpoints the packed tables per shard keyed
-        by the shard manifest's fingerprint.  ``fused`` stays the default
-        (no build cost); pick ``ell``/``compact`` for the fastest repeated
-        applies."""
+        All modes work shard-native: the plan builds stream peer shards
+        from the file one at a time (never all host-side), and
+        ``structure_cache`` checkpoints the packed tables (or the
+        streamed plan) per shard keyed by the shard manifest's
+        fingerprint.  ``fused`` stays the default (no build cost); pick
+        ``ell``/``compact`` for the fastest repeated applies, or
+        ``streamed`` when their tables exceed HBM but the plan fits
+        host RAM/disk."""
         return cls(operator, mesh=mesh, n_devices=n_devices,
                    batch_size=batch_size, mode=mode or "fused",
                    shards_path=shards_path, structure_cache=structure_cache)
@@ -963,6 +1048,12 @@ class DistributedEngine:
             hash_basis_operator(h, self.operator)
         h.update(f"dist|{self.mode}|{self.pair}|{self.real}"
                  f"|{self.n_devices}|{self.shard_size}|v2".encode())
+        if self.mode == "streamed":
+            # the plan's dest/exchange layout bakes in the row-chunk size
+            # and the per-peer capacity; a knob change must miss, not
+            # restore a plan whose scatter targets no longer fit
+            h.update(f"|B{self.batch_size}|cap{self._capacity}"
+                     f"|p{self._lk_probes}|v1".encode())
         self._fp_cache = h.hexdigest()
         return self._fp_cache
 
@@ -1100,7 +1191,8 @@ class DistributedEngine:
         if not isinstance(arr, jax.Array):
             return np.asarray(arr)[d]
         for piece in arr.addressable_shards:
-            if piece.index[0].start == d:
+            # a 1-device mesh yields index slice(None) — start None means 0
+            if (piece.index[0].start or 0) == d:
                 return np.asarray(piece.data)[0]
         return None
 
@@ -1155,6 +1247,520 @@ class DistributedEngine:
             save_engine_structure(sidecar, self._structure_fingerprint(),
                                   self.mode, payload)
         log_debug(f"distributed plan checkpointed to {sidecar}")
+
+    # ------------------------------------------------------------------
+    # Streamed mode: fused-class structure resolved ONCE into a host-RAM
+    # plan (optional artifact-cache disk tier), streamed H2D per apply
+    # ------------------------------------------------------------------
+    #
+    # The fused apply pays N·T·(c_scan·|G| + c_route) EVERY time: the
+    # coset-walk orbit scan (ops/kernels.state_info) plus the hash/bucket
+    # routing are recomputed for every generated amplitude on every apply,
+    # although both are pure functions of the (operator, basis, chunking)
+    # — chain_36_symm could not finish ONE fused apply in 69 minutes, and
+    # a Lanczos solve repeats that identical computation 300–1000×.  The
+    # streamed plan stores, per (row chunk, shard):
+    #
+    #   dest  [B·T] i32       exchange slot (key·Cap + in-bucket rank;
+    #                         D·Cap = dropped), from the SAME
+    #                         _bucket_positions math as the fused apply
+    #   coeff [B, T](,2)      conj-rescaled row coefficient (zero = dead)
+    #   ridx  [D·Cap] i32     receive-side basis index (pre-masked)
+    #   rok   [D·Cap] bool    receive-side validity mask
+    #
+    # so a steady-state apply is: gather the chunk's x rows, multiply by
+    # coeff, scatter to dest, ONE all_to_all of amplitudes only (the betas
+    # no longer travel — the receive side already knows its layout), and a
+    # segment_sum — a bandwidth-bound stream of precomputed structure, in
+    # the spirit of GSPMD's static-program reuse (PAPERS.md).  The plan
+    # spills to host RAM (memory-ledger tracked, device="host") and, when
+    # the artifact layer is on, to a content-addressed sidecar that both
+    # warm-restores later constructions and serves as the disk tier for
+    # plans beyond ``stream_plan_ram_gb``.
+
+    _STREAM_ARRAYS = ("dest", "coeff", "ridx", "rok")
+
+    def _stream_sidecar(self, path: str) -> str:
+        return f"{path}.dist{self.n_devices}.stream.h5"
+
+    def _stream_nchunks(self) -> int:
+        B = self.batch_size
+        return (self.shard_size + B - 1) // B
+
+    def _make_stream_build(self):
+        """One fixed-shape program resolving a row chunk's full structure:
+        kernels + orbit scan, bucket routing (shared `_bucket_positions` —
+        bit-identical to the fused apply), one betas-only all_to_all, and
+        the receive-side lookup.  Outputs the plan arrays plus the psum'd
+        structural overflow/invalid counters."""
+        D, M = self.n_devices, self.shard_size
+        Cap = self._capacity
+        lk_shift, lk_probes = self._lk_shift, self._lk_probes
+        is_pair = self.pair
+        mesh = self.mesh
+
+        def shard_body(a_c, n_c, tables, lk_pair, lk_dir):
+            a, nn = a_c[0], n_c[0]
+            lkp, lkd = lk_pair[0], lk_dir[0]
+            betas, gcoeff = K.gather_coefficients(tables, a, nn)
+            valid_row = (a != SENTINEL_STATE)[:, None]
+            if is_pair:
+                nz = (gcoeff != 0).any(axis=-1) & valid_row
+                cf = jnp.where(nz[..., None], K.conj_pair(gcoeff), 0)
+            else:
+                nz = (gcoeff != 0) & valid_row
+                cf = jnp.where(nz, jnp.conj(gcoeff), 0)
+            flat_b = betas.reshape(-1)
+            live = nz.reshape(-1)
+            owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
+                if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
+            key = jnp.where(live, owner, D)
+            pos = _bucket_positions(key, D)
+            in_cap = (pos < Cap) & (key < D)
+            overflow = jnp.sum((pos >= Cap) & (key < D))
+            dest = jnp.where(in_cap, key * Cap + pos,
+                             D * Cap).astype(jnp.int32)
+            send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
+                flat_b, mode="drop")
+            if D > 1:
+                recv_b = jax.lax.all_to_all(
+                    send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
+                ).reshape(-1)
+            else:
+                recv_b = send_b
+            idx, found = state_index_bucketed(
+                lkp, lkd, recv_b, shift=lk_shift, probes=lk_probes)
+            live_r = recv_b != SENTINEL_STATE
+            okc = found & live_r
+            invalid = jnp.sum(live_r & ~found)
+            ridx = jnp.where(okc, idx, 0).astype(jnp.int32)
+            overflow = jax.lax.psum(overflow, SHARD_AXIS)
+            invalid = jax.lax.psum(invalid, SHARD_AXIS)
+            return (dest[None], cf[None], ridx[None], okc[None],
+                    overflow[None], invalid[None])
+
+        cf_ndim = 4 if is_pair else 3
+
+        def build_fn(a_c, n_c, tables, lk_pair, lk_dir):
+            f = shard_map_compat(
+                shard_body, mesh=mesh,
+                in_specs=(_pspec(2), _pspec(2), P(), _pspec(3), _pspec(2)),
+                out_specs=(_pspec(2), _pspec(cf_ndim), _pspec(2), _pspec(2),
+                           _pspec(1), _pspec(1)),
+            )
+            return f(a_c, n_c, tables, lk_pair, lk_dir)
+
+        return jax.jit(build_fn)
+
+    def _build_stream_plan(self, row_provider) -> None:
+        """Resolve every row chunk's structure once (the cost of roughly
+        ONE fused apply plus the plan D2H) into host-RAM per-chunk arrays.
+        Double-buffered like the ell/compact plan stream: chunk ci+1's
+        upload + device pass is in flight while chunk ci's plan is fetched
+        and packed host-side."""
+        D, M = self.n_devices, self.shard_size
+        B = self.batch_size
+        nchunks = self._stream_nchunks()
+        my_shards = [d for d in range(D) if self._shard_addressable(d)]
+
+        _mem_h = obs_memory.NULL_HANDLE
+        if obs_enabled():
+            cfb = 16 if (self.pair or not self.real) else 8
+            stage = 2 * (B * self.num_terms * (4 + cfb)
+                         + D * self._capacity * 5)
+            _mem_h = obs_memory.track(
+                f"plan/{obs_memory.next_instance('stream_build')}/staging",
+                stage, kind="staging", chunks=int(nchunks))
+        self._plan_stage_h = _mem_h
+
+        build = self._make_stream_build()
+
+        def chunk_rows(d, ci):
+            a_d, n_d = row_provider(d)
+            s, e = ci * B, min((ci + 1) * B, M)
+            a, nn = a_d[s:e], n_d[s:e]
+            if e - s < B:
+                a = np.concatenate(
+                    [a, np.full(B - (e - s), SENTINEL_STATE, np.uint64)])
+                nn = np.concatenate([nn, np.ones(B - (e - s))])
+            return a, nn
+
+        def launch(ci):
+            a_rows = [None] * D
+            n_rows = [None] * D
+            for d in my_shards:
+                a_rows[d], n_rows[d] = chunk_rows(d, ci)
+            a_dev = self._assemble_sharded(a_rows)
+            n_dev = self._assemble_sharded(n_rows)
+            return build(a_dev, n_dev, self.tables, self._lk_pair,
+                         self._lk_dir)
+
+        chunks = []
+        overflow = invalid = 0
+        plan_bytes = 0
+        pending = launch(0) if nchunks else None
+        for ci in range(nchunks):
+            nxt = launch(ci + 1) if ci + 1 < nchunks else None
+            dest, cf, ridx, rok, ov, iv = pending
+            _t_fetch = time.perf_counter()
+            per = {}
+            for d in my_shards:
+                pc = {"dest": self._shard_piece(dest, d),
+                      "coeff": self._shard_piece(cf, d),
+                      "ridx": self._shard_piece(ridx, d),
+                      "rok": self._shard_piece(rok, d)}
+                plan_bytes += sum(a.nbytes for a in pc.values())
+                per[d] = pc
+            histogram("double_buffer_stall_ms").observe(
+                (time.perf_counter() - _t_fetch) * 1e3)
+            counter("bytes_d2h", path="stream_plan_build").inc(sum(
+                a.nbytes for pc in per.values() for a in pc.values()))
+            # overflow/invalid are psum'd — identical on every shard
+            if my_shards:
+                overflow += int(self._shard_piece(ov, my_shards[0]))
+                invalid += int(self._shard_piece(iv, my_shards[0]))
+            chunks.append(per)
+            log_debug(f"stream plan chunk {ci + 1}/{nchunks}")
+            pending = nxt
+        self._plan_chunks = chunks
+        self._plan_disk = None
+        self._plan_files: dict = {}
+        self._plan_nchunks_v = nchunks
+        self.plan_bytes = plan_bytes
+        self._stream_overflow = overflow
+        self._stream_invalid = invalid
+        _mem_h.release()
+        # the loud structural halt, at BUILD time (fused defers it to the
+        # first apply): every rank saw the same psum'd totals, so a raise
+        # cannot strand peers in a collective
+        self._validate_counters(overflow, invalid, "streamed")
+        obs_memory.sample_watermark("plan_build/streamed")
+
+    def _register_stream_plan(self) -> None:
+        """Host-RAM plan bytes into the memory ledger (device="host") for
+        the engine's lifetime + one ``plan_stream`` event the capacity
+        planner and obs reports read."""
+        if not obs_enabled():
+            return
+        import weakref
+
+        tier = "disk" if self._plan_chunks is None else "ram"
+        h = obs_memory.track(
+            f"plan/{obs_memory.next_instance('stream_plan')}/host",
+            int(self.plan_bytes) if tier == "ram" else 0,
+            device="host", kind="stream_plan", tier=tier,
+            chunks=int(self._plan_nchunks_v))
+        weakref.finalize(self, h.release)
+        from ..obs import gauge
+        gauge("stream_plan_bytes").set(int(self.plan_bytes))
+        emit("plan_stream", engine="distributed", tier=tier,
+             plan_bytes=int(self.plan_bytes),
+             chunks=int(self._plan_nchunks_v),
+             capacity=int(self._capacity), batch=int(self.batch_size),
+             overflow=int(self._stream_overflow),
+             invalid=int(self._stream_invalid),
+             host_rss_bytes=obs_memory.host_rss_bytes())
+
+    def _save_stream_plan(self, path: Optional[str], soft: bool = False
+                          ) -> None:
+        """Persist the plan to the artifact-cache sidecar (per-rank file in
+        multi-controller runs, like the v3 structure sidecars) and — when
+        the plan exceeds ``stream_plan_ram_gb`` — demote the RAM copy to
+        the disk tier, reading chunks back from the sidecar per apply."""
+        cfg = get_config()
+        saved = None
+        if path:
+            payload = {"Cap": int(self._capacity), "B": int(self.batch_size),
+                       "nchunks": int(self._plan_nchunks_v),
+                       "overflow": int(self._stream_overflow),
+                       "invalid": int(self._stream_invalid)}
+            for ci, per in enumerate(self._plan_chunks):
+                for d, pc in per.items():
+                    for k in self._STREAM_ARRAYS:
+                        payload[f"{k}_{d}_{ci}"] = pc[k]
+            sidecar = self._stream_sidecar(path)
+            if jax.process_count() > 1:
+                sidecar = f"{sidecar}.r{jax.process_index()}"
+            if soft:
+                from ..utils.artifacts import soft_save_structure
+                if soft_save_structure(sidecar,
+                                       self._structure_fingerprint(),
+                                       self.mode, payload):
+                    saved = sidecar
+            else:
+                from ..io.hdf5 import save_engine_structure
+                save_engine_structure(sidecar,
+                                      self._structure_fingerprint(),
+                                      self.mode, payload)
+                saved = sidecar
+            if saved:
+                log_debug(f"stream plan checkpointed to {saved}")
+        if self.plan_bytes > cfg.stream_plan_ram_gb * 1e9:
+            if saved:
+                D = self.n_devices
+                self._plan_disk = {
+                    d: saved for d in range(D) if self._shard_addressable(d)}
+                self._plan_chunks = None
+                log_debug("stream plan beyond stream_plan_ram_gb: host RAM "
+                          "copy dropped, disk tier active")
+            else:
+                from ..utils.logging import log_warn
+                log_warn(
+                    f"stream plan ({self.plan_bytes / 1e9:.1f} GB) exceeds "
+                    "stream_plan_ram_gb but no artifact-cache sidecar is "
+                    "available as a disk tier; keeping it in host RAM "
+                    "(enable DMT_ARTIFACT_CACHE or raise "
+                    "DMT_STREAM_PLAN_RAM_GB)")
+
+    def _try_load_stream_plan(self, path: Optional[str]) -> bool:
+        """Restore the plan from a stream sidecar: each rank reads only its
+        addressable shards' chunk datasets — from its own ``.r<rank>`` file
+        or any rank's found next to it.  Plans beyond ``stream_plan_ram_gb``
+        stay on disk and are read per chunk during applies."""
+        if not path:
+            return False
+        import glob
+        import os
+
+        import h5py
+
+        sidecar = self._stream_sidecar(path)
+        candidates = [c for c in [sidecar]
+                      + sorted(glob.glob(sidecar + ".r*"))
+                      if os.path.exists(c)]
+        if not candidates:
+            return False
+        fp = self._structure_fingerprint()
+        D = self.n_devices
+        my_shards = [d for d in range(D) if self._shard_addressable(d)]
+        scalars = {}
+        where: dict = {}            # shard -> candidate file holding it
+        for cand in candidates:
+            try:
+                with h5py.File(cand, "r") as f:
+                    if "engine_structure" not in f:
+                        continue
+                    g = f["engine_structure"]
+                    if str(g.attrs.get("fingerprint", "")) != fp:
+                        continue
+                    for k in ("Cap", "B", "nchunks", "overflow", "invalid"):
+                        if k in g.attrs:
+                            scalars[k] = int(g.attrs[k])
+                    for d in my_shards:
+                        if d not in where and f"dest_{d}_0" in g:
+                            where[d] = cand
+            except OSError:
+                continue
+        need = {"Cap", "B", "nchunks", "overflow", "invalid"}
+        if set(my_shards) - set(where) or need - set(scalars):
+            return False
+        if scalars["Cap"] != self._capacity \
+                or scalars["B"] != self.batch_size:
+            return False      # fingerprinted, but belt-and-braces
+        nchunks = scalars["nchunks"]
+        if nchunks != self._stream_nchunks():
+            return False
+        # group shards per candidate so each sidecar opens ONCE for the
+        # sizing pass and once for the RAM load — a chain_32-class plan
+        # has hundreds of (chunk, shard) datasets, and per-dataset reopen
+        # cycles would dominate the warm restore
+        by_file: dict = {}
+        for d, cand in where.items():
+            by_file.setdefault(cand, []).append(d)
+        plan_bytes = 0
+        try:
+            for cand, ds_list in by_file.items():
+                with h5py.File(cand, "r") as f:
+                    g = f["engine_structure"]
+                    for d in ds_list:
+                        for ci in range(nchunks):
+                            for k in self._STREAM_ARRAYS:
+                                ds = g[f"{k}_{d}_{ci}"]
+                                plan_bytes += ds.size * ds.dtype.itemsize
+        except (OSError, KeyError):
+            return False
+        self._plan_nchunks_v = nchunks
+        self.plan_bytes = plan_bytes
+        self._stream_overflow = scalars["overflow"]
+        self._stream_invalid = scalars["invalid"]
+        self._plan_files = {}
+        if plan_bytes > get_config().stream_plan_ram_gb * 1e9:
+            self._plan_chunks = None
+            self._plan_disk = where
+            log_debug(f"stream plan restored on the DISK tier "
+                      f"({plan_bytes / 1e9:.1f} GB from {len(where)} "
+                      "sidecar(s))")
+        else:
+            self._plan_disk = None
+            chunks = [dict() for _ in range(nchunks)]
+            for cand, ds_list in by_file.items():
+                with h5py.File(cand, "r") as f:
+                    g = f["engine_structure"]
+                    for d in ds_list:
+                        for ci in range(nchunks):
+                            chunks[ci][d] = {
+                                k: g[f"{k}_{d}_{ci}"][...]
+                                for k in self._STREAM_ARRAYS}
+            self._plan_chunks = chunks
+            log_debug(f"stream plan restored from {candidates[0]}")
+        self._validate_counters(self._stream_overflow,
+                                self._stream_invalid, "streamed")
+        return True
+
+    def _plan_chunk_host(self, ci: int) -> dict:
+        """One chunk's host-side plan arrays per addressable shard — from
+        the RAM copy, or read back from the disk-tier sidecar (the OS page
+        cache makes repeated applies stream, not re-read cold)."""
+        if self._plan_chunks is not None:
+            return self._plan_chunks[ci]
+        import h5py
+
+        out = {}
+        for d, path in self._plan_disk.items():
+            f = self._plan_files.get(path)
+            if f is None:
+                f = self._plan_files[path] = h5py.File(path, "r")
+            g = f["engine_structure"]
+            out[d] = {k: g[f"{k}_{d}_{ci}"][...]
+                      for k in self._STREAM_ARRAYS}
+        return out
+
+    def _upload_plan_chunk(self, ci: int):
+        """Stage one plan chunk onto the mesh ([D, ...] assembled arrays).
+        Dispatched one chunk AHEAD of the apply loop so the H2D copy
+        overlaps the previous chunk's device pass (the PR-1 double-buffer
+        pattern, now on the apply path)."""
+        per = self._plan_chunk_host(ci)
+        rows = {k: [None] * self.n_devices for k in self._STREAM_ARRAYS}
+        n = 0
+        for d, pc in per.items():
+            for k in self._STREAM_ARRAYS:
+                rows[k][d] = pc[k]
+                n += pc[k].nbytes
+        counter("bytes_h2d", path="plan_stream").inc(n)
+        return tuple(self._assemble_sharded(rows[k])
+                     for k in self._STREAM_ARRAYS)
+
+    def _make_streamed_matvec(self):
+        D, M, T = self.n_devices, self.shard_size, self.num_terms
+        B = self.batch_size
+        Cap = self._capacity
+        nchunks = self._plan_nchunks_v
+        Mp = nchunks * B
+        dtype = self._dtype
+        is_pair = self.pair
+        ptail = (2,) if is_pair else ()
+        mesh = self.mesh
+
+        def make_programs(tail):
+            nbt = len(tail) - len(ptail)   # number of batch axes (0 or 1)
+
+            def shard_body(xp, y, start, dest, coeff, ridx, rok):
+                xp_, y_ = xp[0], y[0]
+                dest_, cf_ = dest[0], coeff[0]
+                ridx_, rok_ = ridx[0], rok[0]
+                zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
+                x_c = jax.lax.dynamic_slice(
+                    xp_, (start,) + zeros, (B,) + tail)
+                # identical arithmetic to the fused chunk: amplitudes are
+                # conj-coefficient × x, dead/overflowed entries dropped by
+                # dest == D·Cap (coeff is pre-zeroed for dead entries)
+                x_t = x_c[:, None]
+                g_t = cf_
+                if nbt:
+                    g_t = g_t[:, :, None, :] if is_pair else g_t[:, :, None]
+                amps = K.cmul_pair(g_t, x_t) if is_pair else g_t * x_t
+                flat_a = amps.reshape((-1,) + tail)
+                send_a = jnp.zeros((D * Cap,) + tail, dtype).at[dest_].set(
+                    flat_a, mode="drop")
+                if D > 1:
+                    recv_a = jax.lax.all_to_all(
+                        send_a.reshape((D, Cap) + tail), SHARD_AXIS, 0, 0,
+                        tiled=True
+                    ).reshape((-1,) + tail)
+                else:
+                    recv_a = send_a
+                y_ = y_ + jax.ops.segment_sum(
+                    jnp.where(rok_.reshape(rok_.shape + (1,) * len(tail)),
+                              recv_a, 0),
+                    ridx_, num_segments=M)
+                return y_[None]
+
+            nd = 2 + len(tail)
+            cf_nd = 3 + len(ptail)
+
+            def chunk_fn(xp, y, start, dest, coeff, ridx, rok):
+                f = shard_map_compat(
+                    shard_body, mesh=mesh,
+                    in_specs=(_pspec(nd), _pspec(nd), P(), _pspec(2),
+                              _pspec(cf_nd), _pspec(2), _pspec(2)),
+                    out_specs=_pspec(nd),
+                )
+                return f(xp, y, start, dest, coeff, ridx, rok)
+
+            chunk_prog = jax.jit(chunk_fn, donate_argnums=(1,))
+            pad_prog = jax.jit(lambda x: jnp.pad(
+                x.astype(dtype),
+                ((0, 0), (0, Mp - M)) + ((0, 0),) * len(tail)))
+            zeros_prog = jax.jit(
+                lambda: jnp.zeros((D, M) + tail, dtype),
+                out_shardings=shard_spec(mesh, nd))
+            epi_prog = jax.jit(
+                lambda y, x, diag: y + diag.astype(dtype).reshape(
+                    diag.shape + (1,) * len(tail)) * x.astype(dtype))
+            return chunk_prog, pad_prog, zeros_prog, epi_prog
+
+        programs: dict = {}
+
+        def run_cols(x):
+            tail = tuple(x.shape[2:])
+            progs = programs.get(tail)
+            if progs is None:
+                progs = programs[tail] = make_programs(tail)
+            chunk_prog, pad_prog, zeros_prog, epi_prog = progs
+            xp = pad_prog(x)
+            y = zeros_prog()
+            record_stall = obs_enabled()
+            pending = self._upload_plan_chunk(0) if nchunks else None
+            for ci in range(nchunks):
+                if record_stall:
+                    # the wait below is the stream's whole performance
+                    # story: ~0 when the upload finished while the device
+                    # ran the previous chunk, the H2D lag otherwise.  It
+                    # exists ONLY to feed the metric — dispatch tracks the
+                    # transfer dependency itself — so DMT_OBS=off skips
+                    # the host sync entirely
+                    _t0 = time.perf_counter()
+                    jax.block_until_ready(pending)
+                    histogram("plan_stream_stall_ms").observe(
+                        (time.perf_counter() - _t0) * 1e3)
+                y = chunk_prog(xp, y, jnp.int32(ci * B), *pending)
+                if ci + 1 < nchunks:
+                    pending = self._upload_plan_chunk(ci + 1)
+            return epi_prog(y, x, self._diag)
+
+        def run(x):
+            # WIDE batches are applied in column groups of 4: per-chunk
+            # scratch (amps [B, T, k] + exchange [D·Cap·k]) grows linearly
+            # in k, and streamed mode exists precisely for bases that
+            # crowd HBM — the same ~4×-a-single-apply bound fused enforces
+            # by shrinking its row chunk.  Each group re-streams the plan;
+            # k ≤ 4 keeps the one-stream-per-apply (and bit-identity-to-
+            # fused) fast path.
+            tl = 1 if is_pair else 0
+            k = x.shape[2] if x.ndim == 3 + tl else 1
+            if k > 4:
+                y = jnp.concatenate(
+                    [run_cols(x[:, :, s:s + 4])
+                     for s in range(0, k, 4)], axis=2)
+            else:
+                y = run_cols(x)
+            self._last_program_key = "streamed"
+            self._last_capacity = Cap
+            return (y, jnp.asarray(self._stream_overflow, jnp.int64),
+                    jnp.asarray(self._stream_invalid, jnp.int64))
+
+        return run
 
     def _make_compact_matvec(self):
         D, C = self.n_devices, self.query_capacity
@@ -1419,31 +2025,10 @@ class DistributedEngine:
                     # Bucket positions: rank within the owner bucket (the
                     # scatter target makes within-bucket order irrelevant —
                     # segment_sum on the receive side is order-insensitive,
-                    # and send_b/send_a share one dest).  For small meshes
-                    # the key takes only D+1 values, so a one-hot cumsum
-                    # gives the rank in one O(N·D) vector pass — measured
-                    # 16% faster than the stable argsort it replaces at
-                    # chain_32_symm, and bit-identical (cumsum rank =
-                    # stable-sort position).  The O(N·D) intermediates grow
-                    # with mesh size, so large meshes keep the O(N log N)
-                    # sort (the crossover is near the sizes where N·D·4B
-                    # per chunk stops fitting in cache).
-                    if D <= 16:
-                        onehot = (key[:, None] == jnp.arange(D)[None, :])
-                        pos_all = jnp.cumsum(onehot.astype(jnp.int32),
-                                             axis=0) - 1
-                        pos = jnp.take_along_axis(
-                            pos_all, jnp.clip(key, 0, D - 1)[:, None],
-                            1)[:, 0]
-                    else:
-                        order = jnp.argsort(key, stable=True)
-                        key_s = key[order]
-                        starts = jnp.searchsorted(key_s, jnp.arange(D + 1))
-                        pos_s = (jnp.arange(key_s.shape[0])
-                                 - starts[jnp.clip(key_s, 0, D)])
-                        inv = jnp.zeros_like(order).at[order].set(
-                            jnp.arange(order.shape[0]))
-                        pos = pos_s[inv]
+                    # and send_b/send_a share one dest).  The helper is
+                    # SHARED with the streamed plan build, which replays
+                    # this exact routing once and stores the result.
+                    pos = _bucket_positions(key, D)
                     in_cap = (pos < Cap) & (key < D)
                     overflow = overflow + jnp.sum((pos >= Cap) & (key < D))
                     dest = jnp.where(in_cap, key * Cap + pos, D * Cap)
@@ -1707,7 +2292,10 @@ class DistributedEngine:
             obs_health.drain()
             idx = self._apply_idx
             self._apply_idx += 1
-            if self.mode == "fused":
+            if self.mode in ("fused", "streamed"):
+                # streamed counters are the build-time structural totals —
+                # constant per plan, but the obs series must stay visible
+                # (zero being the healthy reading) exactly as in fused mode
                 obs_health.defer_exchange_counters("distributed", idx,
                                                    overflow, invalid)
             if obs_health.probe_due(idx):
@@ -1740,6 +2328,13 @@ class DistributedEngine:
         nmy = self._n_my_shards
         if self.mode in ("ell", "compact"):
             return nmy * D * self.query_capacity * tail_elems * 8
+        if self.mode == "streamed":
+            # amplitudes only: the receive side already holds its layout,
+            # so the betas no longer travel (half the fused exchange for
+            # real sectors)
+            item = int(jnp.dtype(self._dtype).itemsize)
+            return (nmy * self._plan_nchunks_v * D * self._capacity
+                    * tail_elems * item)
         cap = (self._last_capacity if self._last_capacity is not None
                else getattr(self, "_capacity", 0))
         B = self._last_program_key or self.batch_size
@@ -1799,7 +2394,20 @@ class DistributedEngine:
     def bound_matvec(self):
         """(apply_fn, operands) — the matvec as a pure function of
         ``(x, operands)``; see :meth:`LocalEngine.bound_matvec` for the
-        jit-composition contract (no large closure constants)."""
+        jit-composition contract (no large closure constants).
+
+        A streamed engine has no single traceable apply program — its
+        matvec is a host-driven stream of per-chunk programs over the
+        host-resident plan — so tracing it into an outer jit (the
+        single-vector Lanczos block runner, LOBPCG) is refused: use
+        :func:`~..solve.lanczos.lanczos_block`, whose eager block applies
+        stream each plan chunk once per k-column block."""
+        if self.mode == "streamed":
+            raise NotImplementedError(
+                "streamed engines cannot be traced into an outer jitted "
+                "program (the plan lives in host RAM and streams per "
+                "apply); use solve.lanczos_block, which applies the "
+                "engine eagerly one multi-RHS block at a time")
         return self._apply_fn, self._operands
 
     def structure_arrays(self) -> dict:
@@ -1832,7 +2440,7 @@ class DistributedEngine:
         out = {"operator_tables": self.tables,
                "basis_rows": (self._alphas, self._norms),
                "diag": self._diag}
-        if self.mode == "fused":
+        if self.mode in ("fused", "streamed"):
             out["lookup"] = (self._lk_pair, self._lk_dir)
         for name, arrs in self.structure_arrays().items():
             out[f"structure/{name}"] = arrs
@@ -1841,7 +2449,11 @@ class DistributedEngine:
     def apply_memory_analysis(self, xh=None) -> Optional[dict]:
         """Compile-time memory analysis of the apply program for ``xh``'s
         shapes (a zero hashed vector by default) — see
-        :meth:`LocalEngine.apply_memory_analysis`."""
+        :meth:`LocalEngine.apply_memory_analysis`.  None for streamed
+        engines: the apply is a host-driven program sequence, not one
+        compiled executable."""
+        if self.mode == "streamed":
+            return None
         if xh is None:
             shape = (self.n_devices, self.shard_size) \
                 + ((2,) if self.pair else ())

@@ -410,6 +410,10 @@ def register_engine_memory(eng, engine_kind: str) -> None:
         ctx["query_capacity"] = int(eng.query_capacity)
     elif getattr(eng, "_capacity", None) is not None:
         ctx["exchange_capacity"] = int(eng._capacity)
+    if getattr(eng, "plan_bytes", None) is not None:
+        # streamed engines: host-RAM plan size, so the capacity planner
+        # can size the streamed tier from the snapshot alone
+        ctx["plan_bytes"] = int(eng.plan_bytes)
     obs_memory.emit_ledger(f"engine_init/{engine_kind}", **ctx)
     obs_memory.sample_watermark(f"engine_init/{engine_kind}")
 
@@ -662,6 +666,15 @@ class LocalEngine:
             self.basis_restored = make_or_restore_basis(basis)
         cfg = get_config()
         mode = mode or cfg.matvec_mode
+        if mode == "streamed":
+            # mode selection is shared with DistributedEngine via
+            # cfg.matvec_mode; point at the engine that implements it
+            # instead of an opaque unknown-mode error
+            raise ValueError(
+                "mode='streamed' lives on DistributedEngine (the plan "
+                "stream reuses its exchange machinery) — use "
+                "DistributedEngine(op, n_devices=1, mode='streamed') for "
+                "a single-device streamed engine")
         if mode not in ("ell", "fused", "compact"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:

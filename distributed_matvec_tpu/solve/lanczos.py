@@ -130,6 +130,76 @@ class _Watchdog:
 _GS_BLOCK = 8
 
 
+class _OmegaTracker:
+    """Accumulated ω-recurrence (Paige/Simon) across selective-reorth blocks.
+
+    Unlike :func:`~..obs.health.omega_estimate` — which assumes a full MGS
+    pass resets the ω table every step and therefore reports only one-step
+    amplification — this tracks the full table ω_{j,i} ≈ |⟨v_j, v_i⟩|
+    across iterations that ran with WINDOW-only reorthogonalization, so the
+    host loop can escalate to a full sweep *before* semiorthogonality
+    (max ω ≤ √ε, Simon '84) is lost.  A full-reorth block (or a thick
+    restart, which rebuilds the basis from Ritz combinations) resets the
+    table to roundoff via :meth:`reset`.
+    """
+
+    def __init__(self, eps: float = 2.0 ** -52):
+        self.eps = eps
+        self.reset(0)
+
+    def reset(self, m: int) -> None:
+        self.m = int(m)
+        # w_curr[i] = ω_{m,i} for i <= m (1 on the diagonal); w_prev the
+        # m-1 row.  Baseline ε: the basis was just (re)orthogonalized.
+        # w_prev's own diagonal (ω_{m-1,m-1} = 1) matters: the recurrence's
+        # −β_{j−1}·ω_{j−1,i} term must cancel the β_{i}·ω_{j,i+1} term at
+        # i = j−1, and an ε there instead of 1 leaves an O(β/β) ~ O(1)
+        # residue that falsely trips the √ε gate on the first window block
+        # after every full sweep.
+        self.w_curr = np.full(self.m + 1, self.eps)
+        self.w_curr[-1] = 1.0
+        self.w_prev = np.full(max(self.m, 1), self.eps)
+        if self.m >= 1:
+            self.w_prev[-1] = 1.0
+
+    def advance(self, alph: np.ndarray, bet: np.ndarray, m_new: int
+                ) -> float:
+        """Evolve the table through steps ``self.m .. m_new-1`` using the
+        recorded (α, β) and return the max off-pair estimate at m_new.
+
+        SIGNED arithmetic, exactly the Paige recurrence — an absolute-value
+        upper bound compounds ~(Σβ)/β per step and saturates √ε within one
+        16-step block, forcing a full sweep every other block (measured:
+        the whole selective win evaporates); the signed form keeps the
+        cancellation that makes real loss grow only as Ritz pairs converge.
+        """
+        a = np.asarray(alph, np.float64)
+        b = np.asarray(bet, np.float64)
+        worst = 0.0
+        for j in range(self.m, int(m_new)):
+            bj = max(float(b[j]), 1e-300)
+            w, wp = self.w_curr, self.w_prev
+            new = np.empty(j + 2)
+            if j:
+                i = np.arange(j)
+                up = b[i] * w[i + 1]
+                mid = (a[i] - a[j]) * w[i]
+                dn = np.zeros(j)
+                dn[1:] = b[i[1:] - 1] * w[i[1:] - 1]
+                back = b[j - 1] * wp[i]
+                # ϑ ≈ ε(β_i + β_j): the local roundoff injected per step
+                new[:j] = (up + mid + dn - back
+                           + self.eps * (b[i] + bj)) / bj
+            new[j] = self.eps          # fresh adjacent pair (ψ term)
+            new[j + 1] = 1.0
+            self.w_prev = w
+            self.w_curr = new
+            if j:
+                worst = max(worst, float(np.max(np.abs(new[:j]))))
+        self.m = int(m_new)
+        return worst
+
+
 @dataclass
 class LanczosResult:
     eigenvalues: np.ndarray          # [k] ascending
@@ -328,8 +398,6 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth, pair=False):
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def run_block(V, alph, bet, m0, nsteps, operands):
         def mgs_pass(wf, Vf, m):
-            nblk = (m + 1 + _GS_BLOCK - 1) // _GS_BLOCK
-
             # NOTE on form: the projections are written as elementwise
             # multiply + sum, NOT `Vb @ wf` / `c @ Vb` — XLA's f64
             # dot_general is ~10× slower than the fused elementwise reduce
@@ -340,8 +408,7 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth, pair=False):
                     * mask.astype(wf.dtype)
                 return wf - jnp.sum(c[:, None] * Vb, axis=0)
 
-            def blk(j, wf):
-                r0 = j * _GS_BLOCK
+            def one_block(r0, wf):
                 Vb = jax.lax.dynamic_slice(
                     Vf, (r0, jnp.zeros((), r0.dtype)), (_GS_BLOCK, nflat))
                 mask = (r0 + jnp.arange(_GS_BLOCK)) <= m
@@ -350,7 +417,9 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth, pair=False):
                     wf = project(wf, J_rows(Vb), mask)
                 return wf
 
-            return jax.lax.fori_loop(0, nblk, blk, wf)
+            nblk = (m + 1 + _GS_BLOCK - 1) // _GS_BLOCK
+            return jax.lax.fori_loop(
+                0, nblk, lambda j, wf: one_block(j * _GS_BLOCK, wf), wf)
 
         def body(i, carry):
             V, alph, bet = carry
@@ -373,6 +442,84 @@ def _make_block_runner(mv, mcap, shape, dtype, n_reorth, pair=False):
         return jax.lax.fori_loop(0, nsteps, body, (V, alph, bet))
 
     return run_block
+
+
+def _make_window_runner(mv, mcap, shape, dtype, n_reorth, nsteps,
+                        pair=False):
+    """Selective-reorthogonalization block: ``nsteps`` iterations whose MGS
+    passes project only against the trailing ``W_ROWS`` rows.
+
+    Structured around a SMALL ring buffer, not the big V carry: the full
+    runner's ``fori_loop`` carries the whole [_buffer_rows, N] basis and
+    XLA's CPU runtime copies that carry on every iteration (measured 28
+    ms/iter for chain_20's 83 MB buffer — a floor that swallowed the whole
+    selective win).  Here the loop carries only the [W_ROWS, N] window,
+    ``lax.scan`` stacks the new vectors in place, and the basis buffer is
+    written ONCE per block — the per-iteration traffic drops from O(mcap·N)
+    to O(window·N).  ``nsteps`` is a compile-time constant (scan needs a
+    static length); a solve sees at most a handful of distinct block
+    lengths, each compiled once.
+
+    The ω-gated host loop guarantees the window is enough: whenever the
+    accumulated orthogonality estimate threatens √ε, the next block runs
+    the full sweep via :func:`_make_block_runner`."""
+    nflat = int(np.prod(shape))
+    nrows = _buffer_rows(mcap)
+    # the trailing window: v_m and v_{m-1} (the recurrence pair) plus two
+    # more recent rows of slack — PROPACK's local reorthogonalization uses
+    # exactly the pair; the ω gate upgrades to full sweeps when locality
+    # stops being enough, so the window stays minimal
+    W_ROWS = 4
+    # one local MGS pass per step (the three-term recurrence + cleanup);
+    # escalated blocks run the full runner with its n_reorth sweeps
+    n_local = max(1, n_reorth - 1)
+
+    def J_rows(A):
+        p = A.reshape(A.shape[:-1] + (nflat // 2, 2))
+        return jnp.stack([-p[..., 1], p[..., 0]],
+                         axis=-1).reshape(A.shape)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def run_window(V, alph, bet, m0, operands):
+        Vf = V.reshape(nrows, nflat)
+        r0 = jnp.maximum(m0 - (W_ROWS - 1), 0)
+        W = jax.lax.dynamic_slice(
+            Vf, (r0, jnp.zeros((), r0.dtype)), (W_ROWS, nflat))
+        # rows above m0 can be stale (short thick restarts leave old basis
+        # rows beyond l) — zero them; zero rows project to nothing
+        W = jnp.where(((r0 + jnp.arange(W_ROWS)) <= m0)[:, None], W, 0)
+        # ring invariant: v_{m0} sits in the LAST row.  When m0 < W_ROWS-1
+        # the clamped slice leaves it at index m0 — roll the (zeroed)
+        # stale rows over the top
+        W = jnp.roll(W, (W_ROWS - 1) - (m0 - r0), axis=0)
+
+        def project(wf, Vb):
+            c = jnp.sum(Vb.conj() * wf[None, :], axis=1)
+            return wf - jnp.sum(c[:, None] * Vb, axis=0)
+
+        def step(W, _i):
+            vm = W[W_ROWS - 1]
+            w = mv(vm.reshape(shape), operands)
+            a = jnp.real(jnp.vdot(vm, w))
+            wf = w.reshape(nflat)
+            for _ in range(n_local):
+                wf = project(wf, W)
+                if pair:
+                    wf = project(wf, J_rows(W))
+            b = jnp.sqrt(jnp.real(jnp.vdot(wf, wf)))
+            vnew = (wf / jnp.where(b <= 1e-300, 1.0, b)).astype(dtype)
+            W = jnp.concatenate([W[1:], vnew[None]], axis=0)
+            return W, (vnew, a, b)
+
+        _, (Vnew, a_blk, b_blk) = jax.lax.scan(
+            step, W, jnp.arange(nsteps))
+        Vf = jax.lax.dynamic_update_slice(
+            Vf, Vnew, (m0 + 1, jnp.zeros((), m0.dtype)))
+        alph = jax.lax.dynamic_update_slice(alph, a_blk, (m0,))
+        bet = jax.lax.dynamic_update_slice(bet, b_blk, (m0,))
+        return Vf.reshape(V.shape), alph, bet
+
+    return run_window
 
 
 def _make_restart(mcap, shape, dtype, l):
@@ -424,28 +571,57 @@ def lanczos_block(
 
     ``max_iters`` counts *individual matvec columns* (p per block step),
     so budgets are comparable with :func:`lanczos`.
+
+    Hashed multi-RHS: a :class:`~..parallel.distributed.DistributedEngine`
+    behind ``matvec`` is driven natively in its hashed ``[D, M, p]``
+    layout — pass ``V0`` of that shape, or pass neither ``V0`` nor ``n``
+    and the start block comes from ``owner.random_hashed(seed, cols=p)``.
+    Each block step is then ONE eager engine apply, so a STREAMED engine
+    streams each plan chunk once per k-column block instead of once per
+    column — this is the solver loop the streamed mode's amortization
+    targets (eigenvectors come back in hashed layout).
     """
     owner = getattr(matvec, "__self__", None)
     if bool(getattr(owner, "pair", False)):
+        streamed = getattr(owner, "mode", None) == "streamed"
         raise ValueError(
             "lanczos_block does not support pair-mode engines "
-            "(J-aware reorthogonalization lives in lanczos())")
+            "(J-aware reorthogonalization lives in lanczos())"
+            + ("; a PAIR-mode STREAMED engine currently has no in-tree "
+               "solver — use mode='ell'/'fused' for pair sectors, or run "
+               "the sector native-c128 on CPU" if streamed else ""))
     p = int(block_size or max(k, 2))
     if p < 1:
         raise ValueError(f"block_size must be >= 1, got {p}")
 
+    hashed_owner = (owner is not None and hasattr(owner, "shard_size")
+                    and hasattr(owner, "random_hashed"))
     if V0 is None:
         if n is None:
-            raise ValueError("pass V0 or n")
-        V0 = _rand_like((n, p), np.float64, seed)
+            if not hashed_owner:
+                raise ValueError("pass V0 or n")
+            V0 = owner.random_hashed(seed, cols=p)      # [D, M, p]
+        else:
+            V0 = _rand_like((n, p), np.float64, seed)
     V0 = jnp.asarray(V0)
+    vec_shape = None         # non-None: hashed [D, M] engine layout
+    if (hashed_owner and V0.ndim == 3
+            and V0.shape[:2] == (owner.n_devices, owner.shard_size)):
+        vec_shape = V0.shape[:2]
+        V0 = V0.reshape(-1, V0.shape[2])   # flat [D·M, p] for the algebra
     if V0.ndim != 2:
-        raise ValueError(f"V0 must be [n, p], got shape {V0.shape}")
+        raise ValueError(f"V0 must be [n, p] (or hashed [D, M, p] for a "
+                         f"distributed engine), got shape {V0.shape}")
     n, p = V0.shape
 
     def mv(X):
-        Y = matvec(X)
-        return Y[0] if isinstance(Y, tuple) else Y
+        # hashed engines consume/produce [D, M, p]; the dense algebra
+        # (QR, projections) runs on the flat [D·M, p] view — pad slots are
+        # zero by engine invariant, so inner products and factorizations
+        # are exact
+        Y = matvec(X.reshape(vec_shape + (p,))) if vec_shape else matvec(X)
+        Y = Y[0] if isinstance(Y, tuple) else Y
+        return Y.reshape(-1, p) if vec_shape else Y
 
     # Probe eagerly with the QR'd first block and REUSE the result as
     # step 0's apply: fixes the dtype (a complex-Hermitian operator
@@ -567,7 +743,8 @@ def lanczos_block(
         evecs = []
         for i in range(kk):
             e = E[:, i]
-            evecs.append(e / jnp.sqrt(jnp.real(jnp.vdot(e, e))).astype(dtype))
+            e = e / jnp.sqrt(jnp.real(jnp.vdot(e, e))).astype(dtype)
+            evecs.append(e.reshape(vec_shape) if vec_shape else e)
     obs_emit("solver_end", solver="lanczos_block", iters=int(total),
              converged=bool(converged),
              eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
@@ -603,6 +780,7 @@ def lanczos(
     pair: Optional[bool] = None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 4,
+    reorth: Optional[str] = None,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs of the Hermitian operator behind ``matvec``.
 
@@ -630,6 +808,17 @@ def lanczos(
     (each rank atomically writes its addressable shards of every Krylov
     row + the replicated recurrence state to ``path.r<rank>``); bare
     callables have no per-shard layout and are ignored with a debug log.
+
+    ``reorth`` picks the reorthogonalization policy (default: the
+    ``lanczos_reorth`` config knob, ``"selective"``): ``"selective"`` runs
+    each iteration's MGS pass against only a trailing window of recent
+    vectors and, when the accumulated ω-recurrence estimate crosses √ε,
+    DISCARDS the block and redoes it with the full sweep (window blocks
+    never touch rows ≤ m, so rollback is free; an info-level
+    ``solver_health`` event marks each trigger; the first block after a
+    restart or resume is always full — the arrowhead coupling row must be
+    projected out).  ``"full"`` is the pre-round-9 behavior: full MGS
+    sweeps every iteration.
     """
     # Engines expose (apply_fn, operands) so the block runner can pass the
     # matrix tables as jit arguments; plain callables fall back to empty
@@ -640,6 +829,19 @@ def lanczos(
     owner = getattr(matvec, "__self__", None)
     if pair is None:
         pair = bool(getattr(owner, "pair", False))
+    if getattr(owner, "mode", None) == "streamed":
+        raise ValueError(
+            "lanczos() traces the matvec into one jitted block program, "
+            "which a streamed engine cannot provide (its plan lives in "
+            "host RAM and streams per apply) — use solve.lanczos_block, "
+            "whose eager multi-RHS block applies stream each plan chunk "
+            "once per block")
+    if reorth is None:
+        from ..utils.config import get_config
+        reorth = get_config().lanczos_reorth
+    if reorth not in ("selective", "full"):
+        raise ValueError(
+            f"unknown reorth policy {reorth!r} (use selective | full)")
 
     if v0 is None:
         if n is None:
@@ -689,8 +891,28 @@ def lanczos(
     alph_d = jnp.zeros(mcap, jnp.float64)
     bet_d = jnp.zeros(mcap, jnp.float64)
 
-    run_block = _make_block_runner(mv, mcap, shape, dtype, n_reorth,
-                                   pair=pair)
+    # Block programs compiled lazily: ONE full-sweep runner (dynamic step
+    # count) and, in selective mode, a window runner per distinct block
+    # length (scan needs a static length; a solve sees only a handful).  A
+    # selective solve that never trips the ω gate compiles only the cheap
+    # window program(s).
+    _runners: dict = {}
+
+    def run_steps(full_pass: bool, V, alph, bet, m, nsteps, operands):
+        if full_pass:
+            rb = _runners.get("full")
+            if rb is None:
+                rb = _runners["full"] = _make_block_runner(
+                    mv, mcap, shape, dtype, n_reorth, pair=pair)
+            return rb(V, alph, bet, jnp.int32(m), jnp.int32(nsteps),
+                      operands)
+        key = ("window", int(nsteps))
+        rw = _runners.get(key)
+        if rw is None:
+            rw = _runners[key] = _make_window_runner(
+                mv, mcap, shape, dtype, n_reorth, int(nsteps), pair=pair)
+        return rw(V, alph, bet, jnp.int32(m), operands)
+
     restart_fn = _make_restart(mcap, shape, dtype, l_restart)
 
     # the Krylov buffer is the solver's whole device footprint — register
@@ -793,9 +1015,27 @@ def lanczos(
     watchdog = _Watchdog("lanczos")
     obs_emit("solver_start", solver="lanczos", k=int(k),
              max_iters=int(max_iters), tol=float(tol), pair=bool(pair),
-             max_basis_size=int(mcap), resumed_from=int(resumed_from))
+             max_basis_size=int(mcap), resumed_from=int(resumed_from),
+             reorth=str(reorth))
     if m and theta is not None:
         _emit_trace("lanczos", total_iters, m, theta, res)
+
+    # Selective-reorth state: the accumulated ω table, and whether the
+    # NEXT block must run the full sweep.  The first block after a resume
+    # (m > 0: the checkpointed basis's ω history is unknown) and after
+    # every thick restart (the arrowhead coupling row must be projected
+    # out of w = H·v_l against ALL locked rows) is always full.
+    selective = reorth == "selective"
+    omega_tr = _OmegaTracker() if selective else None
+    pending_full = bool(m)
+    if selective:
+        # warm the dynamic-step full runner with a ZERO-step call: short
+        # remainder blocks, restarts, and ω fallbacks then reuse its
+        # compiled program instead of landing a compile inside the
+        # steady-rate window (the window program compiles in the first —
+        # rate-excluded — block)
+        V, alph_d, bet_d = run_steps(True, V, alph_d, bet_d, m, 0,
+                                     operands)
 
     while total_iters < max_iters and not converged:
         if m == mcap:
@@ -812,11 +1052,43 @@ def lanczos(
             lock_theta = theta_all[:l].copy()
             lock_sigma = bet[m - 1] * S_all[m - 1, :l]
             m = l
+            pending_full = True
         nsteps = min(check_every, mcap - m, max_iters - total_iters)
+        # tiny remainder stubs (< half a block) reuse the prewarmed
+        # dynamic-step full runner: a fresh window program would spend
+        # more wall on its compile than the handful of iterations saves.
+        # Half-block-or-larger lengths get window programs — pre-restart
+        # remainders recur every restart cycle, so their one compile
+        # amortizes.
+        used_full = (not selective or pending_full
+                     or nsteps < max(check_every // 2, 1))
+        pending_full = False
         t0 = _time.perf_counter()
-        V, alph_d, bet_d = run_block(
-            V, alph_d, bet_d, jnp.int32(m), jnp.int32(nsteps), operands)
+        V, alph_d, bet_d = run_steps(
+            used_full, V, alph_d, bet_d, m, nsteps, operands)
         jax.block_until_ready(V)   # one collective program in flight at a time
+        if selective and not used_full:
+            om_acc = omega_tr.advance(np.asarray(alph_d),
+                                      np.asarray(bet_d), m + nsteps)
+            if om_acc >= obs_health.OMEGA_WARN:   # √ε — Simon's bound
+                # ω crossed √ε inside the window block: semiorthogonality
+                # is no longer guaranteed and cannot be repaired after the
+                # fact — but the block only WROTE rows above m, so the
+                # pre-block state is intact.  Discard it and redo the same
+                # steps with the full sweep (iterations are counted once;
+                # only the wall clock pays).
+                # level "info": a trigger near convergence is the scheme
+                # WORKING (loss grows exactly as Ritz pairs converge),
+                # not a health problem — the zero-warning gate of `make
+                # health-check` must not fail a healthy converged solve
+                obs_emit("solver_health",
+                         check="selective_reorth_fallback", level="info",
+                         solver="lanczos", iter=int(total_iters + nsteps),
+                         omega=float(om_acc))
+                V, alph_d, bet_d = run_steps(
+                    True, V, alph_d, bet_d, m, nsteps, operands)
+                jax.block_until_ready(V)
+                used_full = True
         dt = _time.perf_counter() - t0
         if first_block_iters == 0:
             first_block_s, first_block_iters = dt, nsteps
@@ -837,6 +1109,11 @@ def lanczos(
                 break
         if broke is not None:
             m = broke + 1
+
+        if selective and used_full:
+            # the full sweep left every new vector orthogonal to the
+            # whole live basis — the ω table restarts at roundoff
+            omega_tr.reset(m)
 
         kk = min(k, m)
         T = _projected_matrix(alph, bet, lock_theta, lock_sigma, m)

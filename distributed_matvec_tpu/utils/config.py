@@ -63,7 +63,15 @@ class RuntimeConfig:
     #   square_6x6 whose packed tables fit HBM but whose full-width
     #   intermediates do not
     matvec_mode: str = "ell"               # "ell" (precomputed structure) |
-    #   "compact" (4 B/entry, isotropic real sectors) | "fused" (recompute)
+    #   "compact" (4 B/entry, isotropic real sectors) | "streamed"
+    #   (DistributedEngine: fused-class structure resolved once into a
+    #   host-RAM plan, streamed H2D per apply — no per-apply orbit scan) |
+    #   "fused" (recompute structure every apply)
+    stream_plan_ram_gb: float = 8.0        # host-RAM budget for a streamed
+    #   engine's resolved plan; beyond it the plan is demoted to the
+    #   artifact-cache sidecar (disk tier) and chunks are read back per
+    #   apply — with the artifact layer off the plan stays in RAM with a
+    #   warning (pure host-RAM streaming never writes disk)
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
     term_loop: str = "auto"                # ELL/compact per-term loop form:
@@ -82,6 +90,14 @@ class RuntimeConfig:
     #    compiler indefinitely while f64 and c64 compile in <1 s; engines
     #    refuse native-c128 sectors on the TPU backend unless this is set —
     #    with complex_pair="auto" they run in pair form instead)
+
+    # -- solvers (solve/lanczos.py) -----------------------------------------
+    lanczos_reorth: str = "selective"      # per-iteration reorthogonalization
+    #   policy: "selective" (window MGS against the trailing rows, escalated
+    #   to full MGS blocks when the accumulated ω-recurrence orthogonality
+    #   estimate crosses √ε — the Simon semiorthogonality bound; chain_20 is
+    #   reorth-bound at ~26× the apply cost) | "full" (the pre-round-9
+    #   behavior: full MGS sweeps every iteration)
 
     # -- artifact cache (utils/artifacts.py) --------------------------------
     artifact_cache: str = "on"             # default-on content-addressed

@@ -84,6 +84,19 @@ if os.environ.get("DMT_MH_FAST"):
     err = float(np.abs(y - want).max())
     print(f"[p{pid}] fast ell: matvec max err {err:.3e}", flush=True)
     assert err < 1e-12, err
+    # streamed leg, same rank-local-mesh pattern: the plan build's
+    # shard_map collectives (the betas all_to_all) stay intra-process —
+    # the CPU backend cannot run true multiprocess computations — while
+    # the plan_stream/plan-upload telemetry is still tagged by the real
+    # 2-process job.  Streamed must equal the ell engine's answer.
+    eng_s = DistributedEngine(op,
+                              mesh=make_mesh(devices=jax.local_devices()),
+                              mode="streamed")
+    ys = eng_s.from_hashed(eng_s.matvec(eng_s.to_hashed(x)))
+    err_s = float(np.abs(ys - want).max())
+    print(f"[p{pid}] fast streamed: matvec max err {err_s:.3e}", flush=True)
+    assert err_s < 1e-12, err_s
+    assert eng_s.plan_bytes > 0
     _finish_obs()
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)

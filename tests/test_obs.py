@@ -544,7 +544,10 @@ def test_lanczos_trace_carries_omega(clean_obs, rng):
     traces = obs.events("lanczos_trace")
     assert traces and "omega" in traces[-1]
     assert traces[-1]["omega"] < 1e-8            # healthy: ~eps
-    assert obs.events("solver_health") == []
+    # healthy = zero warn/critical; the selective-reorth fallback marker
+    # (level "info") may legitimately fire as Ritz pairs converge
+    assert [e for e in obs.events("solver_health")
+            if e.get("level") in ("warn", "critical")] == []
     assert obs.events("health") == []
     # the block solver carries the (scalarized) omega estimate too
     from distributed_matvec_tpu.solve import lanczos_block

@@ -110,26 +110,45 @@ def load_structure(path: str) -> dict:
 
 
 def mode_bytes_per_row(T0: int, pair: bool) -> Dict[str, float]:
-    """The analytic per-row structure cost of each mode."""
+    """The analytic per-row structure cost of each mode (DEVICE bytes;
+    streamed keeps no resident structure on device — its plan lives in
+    host RAM, see :func:`stream_plan_bytes_per_row`)."""
     cf = 16 if pair else 8
     return {"ell": T0 * (4 + cf),
             "compact": T0 * 4 + 20,
+            "streamed": 0.0,
             "fused": 0.0}
+
+
+def stream_plan_bytes_per_row(num_terms: int, pair: bool) -> float:
+    """HOST bytes per basis row of a streamed engine's resolved plan:
+    dest i32 + coefficient per (row, term); the per-chunk receive layout
+    (ridx + rok per exchange slot) adds a few percent and is folded into
+    a flat 10% overhead rather than modeled exactly."""
+    cf = 16 if pair else 8
+    return num_terms * (4 + cf) * 1.10
 
 
 def plan(n_states: int, num_terms: int, T0: int, pair: bool,
          hbm_gb: float, n_devices: int, vectors: int, vec_width: int,
          measured: Optional[dict] = None,
-         utilization: float = DEFAULT_UTILIZATION) -> dict:
+         utilization: float = DEFAULT_UTILIZATION,
+         host_ram_gb: float = 64.0) -> dict:
     """The capacity report: bytes/row, max basis per device and per mesh
-    for each mode, plus (optionally) measured calibration."""
+    for each mode, plus (optionally) measured calibration.  The streamed
+    mode is additionally bounded by HOST RAM (``host_ram_gb``, per rank —
+    one rank per device assumed): its resolved plan streams from there,
+    so the binding constraint is min(device rows, host plan rows)."""
     T0 = int(T0) if T0 else int(num_terms)
     per_mode = mode_bytes_per_row(T0, pair)
+    plan_row = stream_plan_bytes_per_row(int(num_terms), pair)
     vec_bytes = 8 * vectors * max(vec_width, 1) * (2 if pair else 1)
     common = COMMON_ROW_BYTES + vec_bytes
     budget = hbm_gb * 1e9 * utilization
+    host_budget = host_ram_gb * 1e9 * utilization
     out = {"inputs": {"n_states": int(n_states), "num_terms": int(num_terms),
                       "T0": T0, "pair": bool(pair), "hbm_gb": hbm_gb,
+                      "host_ram_gb": host_ram_gb,
                       "n_devices": int(n_devices), "vectors": vectors,
                       "vec_width": vec_width, "utilization": utilization},
            "modes": {}}
@@ -141,31 +160,44 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
             per_mode[mmode] = measured["table_bytes"] / float(n_pad)
             out["calibration"] = dict(
                 measured, bytes_per_row_measured=round(per_mode[mmode], 2))
+        if mmode == "streamed" and measured.get("plan_bytes") and n_pad:
+            plan_row = measured["plan_bytes"] / float(n_pad)
+            out["calibration"] = dict(
+                out["calibration"],
+                plan_bytes_per_row_measured=round(plan_row, 2))
     for mode, struct_bytes in per_mode.items():
         row = struct_bytes + common
         rows_dev = int(budget // row)
-        out["modes"][mode] = {
+        entry = {
             "structure_bytes_per_row": round(struct_bytes, 2),
             "bytes_per_row": round(row, 2),
+        }
+        if mode == "streamed":
+            entry["host_plan_bytes_per_row"] = round(plan_row, 2)
+            rows_dev = min(rows_dev, int(host_budget // plan_row))
+        entry.update({
             "max_rows_per_device": rows_dev,
             "max_basis_size": rows_dev * n_devices,
             "fits_n_states": bool(n_states <= rows_dev * n_devices),
             "devices_needed_for_n_states":
                 max(1, math.ceil(n_states / rows_dev)) if rows_dev else None,
-        }
+        })
+        out["modes"][mode] = entry
     return out
 
 
 def recommend(report: dict, target_n: Optional[int]) -> dict:
     """Mode/shard recommendation for ``target_n`` (or the input basis):
-    the cheapest-per-apply mode (ell > compact > fused preference order
-    matches measured apply speed) that fits within the given mesh, else
-    the minimal shard count per mode."""
+    the cheapest-per-apply mode (ell > compact > streamed > fused
+    preference order matches measured apply speed — streamed beats fused
+    whenever its plan fits the RAM/disk budget, because steady applies
+    skip the whole orbit scan) that fits within the given mesh, else the
+    minimal shard count per mode."""
     n = int(target_n or report["inputs"]["n_states"])
     D = report["inputs"]["n_devices"]
     rec = {"target_n": n}
     options = []
-    for mode in ("ell", "compact", "fused"):
+    for mode in ("ell", "compact", "streamed", "fused"):
         m = report["modes"][mode]
         need = max(1, math.ceil(n / m["max_rows_per_device"])) \
             if m["max_rows_per_device"] else None
@@ -200,13 +232,15 @@ def print_report(report: dict, rec: dict) -> None:
                  if "bytes_per_row_measured" in cal else ""))
     print(f"  {'mode':<9} {'struct B/row':>13} {'total B/row':>12} "
           f"{'max rows/device':>16} {'max basis (mesh)':>17}  fits N?")
-    for mode in ("ell", "compact", "fused"):
+    for mode in ("ell", "compact", "streamed", "fused"):
         m = report["modes"][mode]
+        note = (f"  (+{m['host_plan_bytes_per_row']:.0f} B/row host plan)"
+                if "host_plan_bytes_per_row" in m else "")
         print(f"  {mode:<9} {m['structure_bytes_per_row']:>13.1f} "
               f"{m['bytes_per_row']:>12.1f} "
               f"{m['max_rows_per_device']:>16,} "
               f"{m['max_basis_size']:>17,}  "
-              f"{'yes' if m['fits_n_states'] else 'no'}")
+              f"{'yes' if m['fits_n_states'] else 'no'}{note}")
     print(f"  recommendation: {rec['note']}")
 
 
@@ -226,6 +260,10 @@ def main(argv=None) -> int:
                     help="(re, im)-f64 pair sector (16 B coefficients)")
     ap.add_argument("--hbm-gb", type=float, default=16.0,
                     help="device memory budget in GB (default 16)")
+    ap.add_argument("--host-ram-gb", type=float, default=64.0,
+                    help="host RAM budget per rank in GB for the streamed "
+                         "mode's resolved plan (default 64; the disk tier "
+                         "extends it when the artifact cache is on)")
     ap.add_argument("--utilization", type=float,
                     default=DEFAULT_UTILIZATION,
                     help="usable fraction of HBM (default 0.85)")
@@ -245,7 +283,14 @@ def main(argv=None) -> int:
         led = snap["ledger"]
         measured = {k: led.get(k) for k in
                     ("mode", "n_states", "n_padded", "shard_size",
-                     "n_devices", "T0", "table_bytes", "num_terms", "pair")}
+                     "n_devices", "T0", "table_bytes", "num_terms", "pair",
+                     "plan_bytes")}
+        if measured.get("plan_bytes"):
+            # a rank's ledger reports its OWN shards' plan bytes; the
+            # per-row calibration divides by the GLOBAL padded row count,
+            # so scale to the whole job (event envelopes carry n_ranks)
+            measured["plan_bytes"] = int(measured["plan_bytes"]) \
+                * int(led.get("n_ranks", 1) or 1)
         if measured.get("n_padded") is None and led.get("shard_size"):
             measured["n_padded"] = int(led["shard_size"]) \
                 * int(led.get("n_devices", 1))
@@ -275,7 +320,8 @@ def main(argv=None) -> int:
 
     report = plan(n_states, num_terms, T0, pair, args.hbm_gb, n_devices,
                   args.vectors, args.vec_width, measured=measured,
-                  utilization=args.utilization)
+                  utilization=args.utilization,
+                  host_ram_gb=args.host_ram_gb)
     rec = recommend(report, int(args.target_n) if args.target_n else None)
     if args.json:
         print(json.dumps({"report": report, "recommendation": rec},

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""stream-check — CI gate for the streamed engine mode (`make stream-check`).
+
+Asserts, on a small |G|>1 config over 2 virtual CPU devices:
+
+1. **Bit-identity** — the streamed apply reproduces the fused apply
+   exactly (same routing, same accumulation order), for single vectors
+   and a k=3 batch, and ⟨x, Hx⟩ matches to the bit.
+2. **Counters preserved** — after streamed applies the
+   ``exchange_overflow`` / ``exchange_invalid`` series exist in the
+   metrics registry (zero being the healthy reading), exactly as fused
+   mode reports them.
+3. **Steady-state speedup** — second-and-later streamed applies beat
+   fused, gated through ``tools/obs_report.py diff`` (the direction-aware
+   CI gate: fused is the baseline run, streamed the candidate, threshold
+   ``1/min_speedup − 1`` so missing the speedup exits 1).  Retried like
+   `make obs-check` — wall-clock noise on a shared host passes on a later
+   attempt, a genuine regression fails all three.
+4. **Pure host-RAM streaming** — the whole main phase runs with
+   ``DMT_ARTIFACT_CACHE=off`` and must write NOTHING under the (scratch)
+   artifact root: no disk tier, no sidecars, plan held in RAM only.
+5. **Artifact-cache round-trip** — with the cache pointed at a scratch
+   root the plan sidecar is written once and a second engine restores it
+   (``structure_restored``) bit-identically.
+"""
+
+import os
+import subprocess
+import sys
+
+# platform pins BEFORE any jax import (same discipline as tests/conftest)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def main() -> int:
+    import argparse
+    import json
+    import tempfile
+    import time
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--min-speedup", type=float, default=1.5,
+                    help="required steady-state streamed-vs-fused speedup "
+                         "(default 1.5; the CPU rig measures ~5x+ on "
+                         "chain_24_symm-class configs, this small gate "
+                         "config keeps headroom for shared-host noise)")
+    ap.add_argument("--spins", type=int, default=18,
+                    help="chain length of the gate config (default 18)")
+    ap.add_argument("--attempts", type=int, default=3)
+    args = ap.parse_args()
+
+    scratch = tempfile.mkdtemp(prefix="dmt_stream_check_")
+    art_root = os.path.join(scratch, "artifacts")
+    os.environ["DMT_ARTIFACT_CACHE"] = "off"
+    os.environ["DMT_ARTIFACT_DIR"] = art_root
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                        heisenberg_from_edges)
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+
+    ns = args.spins
+    basis = SpinBasis(number_spins=ns, hamming_weight=ns // 2,
+                      spin_inversion=1,
+                      symmetries=[([*range(1, ns), 0], 0),
+                                  ([*reversed(range(ns))], 0)])
+    op = heisenberg_from_edges(basis, chain_edges(ns))
+    basis.build()
+    n = basis.number_states
+    assert op.basis.group is not None, "gate config must have |G| > 1"
+    print(f"[stream-check] chain_{ns}_symm: N={n}, |G|>1, 2 shards")
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(n)
+    x /= np.linalg.norm(x)
+
+    eng_f = DistributedEngine(op, n_devices=2, mode="fused")
+    eng_s = DistributedEngine(op, n_devices=2, mode="streamed")
+    xf, xs = eng_f.to_hashed(x), eng_s.to_hashed(x)
+
+    # -- 1. bit-identity ---------------------------------------------------
+    yf = np.asarray(eng_f.matvec(xf))
+    ys = np.asarray(eng_s.matvec(xs))
+    assert np.array_equal(yf, ys), \
+        f"streamed y differs from fused (max |d|={np.abs(yf - ys).max()})"
+    assert float(np.vdot(np.asarray(xf), yf)) \
+        == float(np.vdot(np.asarray(xs), ys)), "<x,Hx> differs"
+    X3 = np.stack([x, -x, 0.5 * x], axis=1)
+    Yf = np.asarray(eng_f.matvec(eng_f.to_hashed(X3)))
+    Ys = np.asarray(eng_s.matvec(eng_s.to_hashed(X3)))
+    assert np.array_equal(Yf, Ys), "k=3 batch differs"
+    print("[stream-check] bit-identity: OK (single + k=3 batch + <x,Hx>)")
+
+    # -- 2. counters preserved --------------------------------------------
+    obs.health_event_count()          # drains the deferred counter fetches
+    counters = obs.snapshot()["counters"]
+    for name in ("exchange_overflow", "exchange_invalid"):
+        hits = {k: v for k, v in counters.items() if k.startswith(name)}
+        assert hits, f"{name} series missing after streamed applies"
+        assert all(v == 0 for v in hits.values()), \
+            f"nonzero {name} on a healthy run: {hits}"
+    print("[stream-check] exchange counters: present at zero")
+
+    # -- 4. pure host-RAM streaming (cache off) ----------------------------
+    assert eng_s._plan_chunks is not None and eng_s._plan_disk is None, \
+        "plan not resident in host RAM with the artifact layer off"
+    assert not os.path.exists(art_root) or not any(os.scandir(art_root)), \
+        f"DMT_ARTIFACT_CACHE=off still wrote under {art_root}"
+    print("[stream-check] cache-off leg: pure host-RAM, no disk writes")
+
+    # -- 3. steady-state speedup via the obs_report diff gate --------------
+    import obs_report
+
+    threshold = 1.0 / args.min_speedup - 1.0
+    repeats = 10
+    ok = False
+    for attempt in range(1, args.attempts + 1):
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            yh = eng_f.matvec(xf)
+        jax.block_until_ready(yh)
+        fused_ms = (time.perf_counter() - t0) / repeats * 1e3
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            yh = eng_s.matvec(xs)
+        jax.block_until_ready(yh)
+        stream_ms = (time.perf_counter() - t0) / repeats * 1e3
+        base_j = os.path.join(scratch, f"fused{attempt}.json")
+        new_j = os.path.join(scratch, f"streamed{attempt}.json")
+        for path, ms in ((base_j, fused_ms), (new_j, stream_ms)):
+            with open(path, "w") as f:
+                json.dump({"stream_gate": {"config": "stream_gate",
+                                           "steady_apply_ms": ms}}, f)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "obs_report.py"),
+             "diff", base_j, new_j, "--config", "stream_gate",
+             "--metric", "steady_apply_ms",
+             "--threshold", str(threshold)])
+        print(f"[stream-check] attempt {attempt}: fused {fused_ms:.2f} ms, "
+              f"streamed {stream_ms:.2f} ms "
+              f"({fused_ms / max(stream_ms, 1e-9):.1f}x)")
+        if r.returncode == 0:
+            ok = True
+            break
+        print("[stream-check] speedup gate missed; retrying "
+              "(noise vs a genuine regression resolves by attempt "
+              f"{args.attempts})")
+    assert ok, (f"steady streamed applies never reached "
+                f"{args.min_speedup}x over fused")
+
+    # -- 5. artifact-cache round-trip --------------------------------------
+    os.environ["DMT_ARTIFACT_CACHE"] = "on"
+    e1 = DistributedEngine(op, n_devices=2, mode="streamed")
+    assert not e1.structure_restored, "fresh cache unexpectedly warm"
+    e2 = DistributedEngine(op, n_devices=2, mode="streamed")
+    assert e2.structure_restored, "plan sidecar did not restore"
+    y2 = np.asarray(e2.matvec(e2.to_hashed(x)))
+    assert np.array_equal(y2, ys), "restored plan differs from built plan"
+    print("[stream-check] artifact round-trip: saved once, restored "
+          "bit-identically")
+
+    print("[stream-check] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
