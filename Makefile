@@ -12,7 +12,7 @@ OBS_THRESHOLD ?= 0.2
 HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
-	obs-check health-check mem-check stream-check clean
+	obs-check health-check mem-check stream-check fault-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -20,6 +20,7 @@ check:
 	$(MAKE) health-check
 	$(MAKE) mem-check
 	$(MAKE) stream-check
+	$(MAKE) fault-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -86,6 +87,18 @@ mem-check:
 # disk writes, and the plan sidecar save/restore round-trip.
 stream-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/stream_check.py
+
+# Chaos gate (tools/fault_check.py): the ROADMAP's resumed-run
+# bit-consistency acceptance as a repeatable gate — kill a 2-device solve
+# mid-iteration (SIGTERM → EXIT_PREEMPTED with a safe-point checkpoint;
+# SIGKILL → cadence checkpoint), resume with the same argv, and assert the
+# resumed E0 matches an uninterrupted run to rtol 1e-12; then inject each
+# DMT_FAULT site (artifact read, checkpoint write/rename, exchange, plan
+# upload, disk-tier plan-chunk read incl. a checksum-corrupt sidecar) and
+# assert the documented retry/degrade/rebuild behavior, bit-identically.
+# Deterministic seeds, < 90 s on the CPU rig.
+fault-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/fault_check.py
 
 # Numerical-health gate (tools/health_check.py): chain-16 smoke applies
 # with probes on vs off in ONE process (same warm engine — cross-process

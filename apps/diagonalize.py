@@ -82,10 +82,11 @@ def main(argv=None):
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--solver-checkpoint", default=None, metavar="CKPT_H5",
-                    help="mid-solve Lanczos checkpoint/resume file (beyond "
-                         "the reference: PRIMME state is never saved there); "
-                         "a rerun with the same config resumes the Krylov "
-                         "recurrence where it stopped")
+                    help="mid-solve Lanczos/LOBPCG checkpoint/resume file "
+                         "(beyond the reference: PRIMME state is never "
+                         "saved there); a rerun with the same config "
+                         "resumes where it stopped — including after a "
+                         "preemption exit (code 75)")
     ap.add_argument("--checkpoint-every", type=int, default=4,
                     help="solver-checkpoint cadence in convergence-check "
                          "blocks (each block is check_every=16 iterations; "
@@ -139,11 +140,35 @@ def main(argv=None):
                          process_id=args.process_id)
     if args.timings:
         update_config(display_timings=True)
+    # preemption latch BEFORE any long-running phase: a SIGTERM during the
+    # basis/engine build still latches, and the solve exits at its first
+    # safe point with a checkpoint + EXIT_PREEMPTED (resume = same argv)
+    import signal as _signal
+
+    from distributed_matvec_tpu.utils import preempt as _preempt
+    from distributed_matvec_tpu.utils.preempt import (EXIT_PREEMPTED,
+                                                      Preempted)
+    # a batch driver opts Ctrl-C into the latch too (library solves
+    # install SIGTERM only, keeping interactive KeyboardInterrupt alive)
+    _preempt.ensure_installed(signals=(_signal.SIGTERM, _signal.SIGINT))
     import jax
     # multi-controller: every rank computes, rank 0 owns the output file
     # (the reference's locale-0 I/O role, MyHDF5.chpl:215-252)
     rank0 = jax.process_index() == 0
     out = args.output or os.path.splitext(args.input)[0] + ".h5"
+    # cross-rank heartbeat watchdog (DMT_HEARTBEAT_S > 0): a hung peer
+    # becomes a stall_report + EXIT_STALLED instead of an infinite
+    # all_to_all wait
+    watchdog = None
+    from distributed_matvec_tpu.utils.config import get_config
+    _cfg = get_config()
+    if _cfg.heartbeat_s > 0 and jax.process_count() > 1:
+        from distributed_matvec_tpu.parallel.heartbeat import (
+            HeartbeatWatchdog)
+        hb_dir = args.obs_dir or os.path.dirname(os.path.abspath(out))
+        watchdog = HeartbeatWatchdog(
+            hb_dir, interval_s=_cfg.heartbeat_s,
+            timeout_s=_cfg.heartbeat_timeout_s).start()
     timer = TreeTimer("diagonalize")
     obs.emit("run_start", app="diagonalize", input=args.input, output=out,
              k=args.num_evals, devices=args.devices,
@@ -208,64 +233,103 @@ def main(argv=None):
 
     from distributed_matvec_tpu.utils.profiling import maybe_profile
 
-    with timer.scope("solve"), maybe_profile():
-        t0 = time.perf_counter()
-        if args.block:
-            if jax.process_count() > 1 and not hasattr(eng, "from_hashed"):
-                print("--block (LOBPCG) in a multi-process run needs a "
-                      "distributed engine (--devices or --shards)",
-                      file=sys.stderr)
-                return 2
-            if args.solver_checkpoint:
-                print("warning: --solver-checkpoint applies to Lanczos "
-                      "only; LOBPCG runs are not checkpointed",
-                      file=sys.stderr)
-            evals, evecs_cols, iters = lobpcg(
-                eng.matvec, n, k=args.num_evals, tol=args.tol,
-                max_iters=args.max_iters)
-            # lobpcg returns block-order columns for both engines; route
-            # the residual matvec through the block-facing entry point
-            mv_block = getattr(eng, "matvec_global", None) \
-                or (lambda v: np.asarray(eng.matvec(v)))
-            evecs = [evecs_cols[:, i] for i in range(evecs_cols.shape[1])]
-            residuals = np.array([
-                float(np.linalg.norm(mv_block(v) - w * np.asarray(v)))
-                for w, v in zip(evals, evecs)])
-            niter = iters
-        elif args.mode == "streamed":
-            # a streamed engine cannot be traced into the single-program
-            # Lanczos block runner — drive it with the eager block solver
-            # (each k-column block streams the plan once)
-            from distributed_matvec_tpu.solve import lanczos_block
-            if args.solver_checkpoint:
-                print("warning: --solver-checkpoint applies to the "
-                      "single-vector Lanczos only; streamed-mode block "
-                      "solves are not checkpointed", file=sys.stderr)
-            res = lanczos_block(eng.matvec, k=args.num_evals,
-                                tol=args.tol, max_iters=args.max_iters,
-                                seed=42,
-                                compute_eigenvectors=not
-                                args.no_eigenvectors)
-            evals, residuals, niter = (res.eigenvalues, res.residual_norms,
-                                       res.num_iters)
-            evecs = res.eigenvectors
-            if not res.converged:
-                print("warning: solver did not converge", file=sys.stderr)
-        else:
-            res = lanczos(eng.matvec, n=None if v0 is not None else n,
-                          v0=v0, k=args.num_evals, tol=args.tol,
-                          max_iters=args.max_iters,
-                          max_basis_size=args.max_basis_size,
-                          min_restart_size=args.min_restart_size,
-                          checkpoint_path=args.solver_checkpoint,
-                          checkpoint_every=args.checkpoint_every,
-                          compute_eigenvectors=not args.no_eigenvectors)
-            evals, residuals, niter = (res.eigenvalues, res.residual_norms,
-                                       res.num_iters)
-            evecs = res.eigenvectors
-            if not res.converged:
-                print("warning: solver did not converge", file=sys.stderr)
-        dt = time.perf_counter() - t0
+    resumed_from = 0
+    try:
+        with timer.scope("solve"), maybe_profile():
+            t0 = time.perf_counter()
+            if args.block:
+                if jax.process_count() > 1 \
+                        and not hasattr(eng, "from_hashed"):
+                    print("--block (LOBPCG) in a multi-process run needs a "
+                          "distributed engine (--devices or --shards)",
+                          file=sys.stderr)
+                    return 2
+                evals, evecs_cols, iters = lobpcg(
+                    eng.matvec, n, k=args.num_evals, tol=args.tol,
+                    max_iters=args.max_iters,
+                    checkpoint_path=args.solver_checkpoint,
+                    # the flag counts Lanczos convergence-check blocks of
+                    # check_every=16 iterations; LOBPCG segments count
+                    # iterations directly, so scale for a comparable cadence
+                    checkpoint_every=max(args.checkpoint_every, 1) * 16)
+                # lobpcg returns block-order columns for both engines;
+                # route the residual matvec through the block-facing entry
+                # point
+                mv_block = getattr(eng, "matvec_global", None) \
+                    or (lambda v: np.asarray(eng.matvec(v)))
+                evecs = [evecs_cols[:, i]
+                         for i in range(evecs_cols.shape[1])]
+                residuals = np.array([
+                    float(np.linalg.norm(mv_block(v) - w * np.asarray(v)))
+                    for w, v in zip(evals, evecs)])
+                niter = iters
+                # lobpcg's 3-tuple API carries no resume count — surface
+                # the solver_resume event so a relaunched run prints the
+                # same confirmation line Lanczos does
+                resumed = [e for e in obs.events("solver_resume")
+                           if e.get("solver") == "lobpcg"]
+                if resumed:
+                    resumed_from = int(resumed[-1]["iters"])
+            elif args.mode == "streamed":
+                # a streamed engine cannot be traced into the
+                # single-program Lanczos block runner — drive it with the
+                # eager block solver (each k-column block streams the plan
+                # once)
+                from distributed_matvec_tpu.solve import lanczos_block
+                if args.solver_checkpoint:
+                    print("warning: --solver-checkpoint applies to the "
+                          "single-vector Lanczos and LOBPCG; "
+                          "streamed-mode block solves exit cleanly on "
+                          "preemption but are not checkpointed",
+                          file=sys.stderr)
+                res = lanczos_block(eng.matvec, k=args.num_evals,
+                                    tol=args.tol, max_iters=args.max_iters,
+                                    seed=42,
+                                    compute_eigenvectors=not
+                                    args.no_eigenvectors)
+                evals, residuals, niter = (res.eigenvalues,
+                                           res.residual_norms,
+                                           res.num_iters)
+                evecs = res.eigenvectors
+                if not res.converged:
+                    print("warning: solver did not converge",
+                          file=sys.stderr)
+            else:
+                res = lanczos(eng.matvec, n=None if v0 is not None else n,
+                              v0=v0, k=args.num_evals, tol=args.tol,
+                              max_iters=args.max_iters,
+                              max_basis_size=args.max_basis_size,
+                              min_restart_size=args.min_restart_size,
+                              checkpoint_path=args.solver_checkpoint,
+                              checkpoint_every=args.checkpoint_every,
+                              compute_eigenvectors=not args.no_eigenvectors)
+                evals, residuals, niter = (res.eigenvalues,
+                                           res.residual_norms,
+                                           res.num_iters)
+                evecs = res.eigenvectors
+                resumed_from = res.resumed_from
+                if not res.converged:
+                    print("warning: solver did not converge",
+                          file=sys.stderr)
+            dt = time.perf_counter() - t0
+    except Preempted as e:
+        # checkpoint-and-exit: the solver already wrote a generation-agreed
+        # checkpoint (when configured) and flushed its events; close the
+        # run's telemetry and hand the supervisor the distinct exit code —
+        # a relaunch with the SAME argv resumes from the checkpoint
+        print(f"preempted: {e}", file=sys.stderr)
+        obs.emit("run_preempted", app="diagonalize", solver=e.solver,
+                 iters=int(e.iters), checkpoint=e.checkpoint_path or "",
+                 exit_code=EXIT_PREEMPTED)
+        timer.emit(app="diagonalize")
+        obs.emit("metrics_snapshot", metrics=obs.snapshot())
+        obs.flush()
+        if watchdog is not None:
+            watchdog.stop()
+        return EXIT_PREEMPTED
+    if resumed_from:
+        print(f"solver: resumed from {resumed_from} checkpointed "
+              "iterations")
     print(f"solver: {niter} iterations in {dt:.2f}s "
           f"({niter / max(dt, 1e-9):.2f} iters/s)")
     obs.emit("diagonalize_result",
@@ -429,6 +493,8 @@ def main(argv=None):
     obs.emit("metrics_snapshot", metrics=obs.snapshot())
     obs.flush()
     timer.report()
+    if watchdog is not None:
+        watchdog.stop()
     return 0
 
 

@@ -73,7 +73,10 @@ def save_engine_structure(path: str, fingerprint: str, mode: str,
     import os
     import tempfile
 
+    from ..utils import faults
+
     h5py = _h5py()
+    faults.check("ckpt_write", path=path)
     dirname = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(suffix=".h5.tmp", dir=dirname)
     os.close(fd)
@@ -96,7 +99,13 @@ def save_engine_structure(path: str, fingerprint: str, mode: str,
             # fingerprint LAST: a partially written file (killed mid-save)
             # then fails the fingerprint check instead of restoring garbage
             g.attrs["fingerprint"] = fingerprint
+        faults.check("ckpt_rename", path=path)
         os.replace(tmp, path)
+        # a complete fresh file landed: clear any corruption history so
+        # the healed path is not one transient blip away from quarantine
+        from ..utils.artifacts import note_artifact_ok
+
+        note_artifact_ok(path)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -111,10 +120,14 @@ def load_engine_structure(path: str, fingerprint: str) -> Optional[dict]:
     an error)."""
     import os
 
+    from ..utils import faults
+
     if not path or not os.path.exists(path):
         return None
     h5py = _h5py()
-    try:
+
+    def _read():
+        faults.check("artifact_read", path=path)
         with h5py.File(path, "r") as f:
             if "engine_structure" not in f:
                 return None
@@ -125,8 +138,16 @@ def load_engine_structure(path: str, fingerprint: str) -> Optional[dict]:
             for k in g:
                 out[k] = g[k][...]
             return out
-    except OSError:
-        # truncated/corrupt checkpoint: rebuild rather than crash
+
+    try:
+        # bounded retry for the transient case; a persistently
+        # truncated/corrupt checkpoint rebuilds AND feeds the
+        # corrupt/quarantine tally (utils/artifacts.py)
+        return faults.with_retries("artifact_read", _read)
+    except OSError as e:
+        from ..utils.artifacts import note_artifact_corrupt
+
+        note_artifact_corrupt(path, "structure", e)
         return None
 
 
@@ -172,16 +193,54 @@ def make_or_restore_representatives(basis, path: Optional[str],
     path is given and ``save`` is True, checkpointed).  In a multi-process
     run every rank should RESTORE from the same path (so all ranks agree on
     the representative set even against a stale checkpoint) but only one
-    rank should ``save``."""
+    rank should ``save``.
+
+    The restore read is retried (transient disk blips) and a persistently
+    corrupt checkpoint degrades to a rebuild + the corrupt/quarantine tally
+    — it used to propagate the OSError and kill the run."""
     if path is not None:
-        got = load_basis(path)
+        import os
+
+        from ..utils import faults
+
+        def _load():
+            if os.path.exists(path):
+                faults.check("artifact_read", path=path)
+            return load_basis(path)
+
+        try:
+            got = faults.with_retries("artifact_read", _load)
+        except OSError as e:
+            from ..utils.artifacts import note_artifact_corrupt
+
+            note_artifact_corrupt(path, "basis", e)
+            got = None
         if got is not None:
             reps, norms = got
             basis.unchecked_set_representatives(reps, norms)
             return True
     basis.build()
     if path is not None and save:
-        save_basis(path, basis.representatives, basis.norms)
+        from ..utils.artifacts import note_artifact_ok
+
+        try:
+            save_basis(path, basis.representatives, basis.norms)
+            note_artifact_ok(path)
+        except OSError as e:
+            # a corrupt pre-existing file refuses h5py appends too — move
+            # it aside (it already failed its read above) and write fresh;
+            # if even that fails, the checkpoint is lost but the run lives
+            from ..utils.artifacts import quarantine_artifact
+            from ..utils.logging import log_warn
+
+            if quarantine_artifact(path, "basis", reason=repr(e)):
+                try:
+                    save_basis(path, basis.representatives, basis.norms)
+                    note_artifact_ok(path)
+                except OSError as e2:
+                    log_warn(f"basis checkpoint save skipped: {e2!r}")
+            else:
+                log_warn(f"basis checkpoint save skipped: {e!r}")
     return False
 
 

@@ -129,10 +129,13 @@ def save_hashed_vectors(path: str, vectors: dict, counts,
     import h5py
     import jax
 
+    from ..utils import faults
+
     counts = np.asarray(counts, np.int64)
     D = counts.size
     if jax.process_count() > 1:
         path = f"{path}.r{jax.process_index()}"
+    faults.check("ckpt_write", path=path)
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".tmp")
     os.close(fd)
@@ -185,7 +188,11 @@ def save_hashed_vectors(path: str, vectors: dict, counts,
                         g.create_dataset(k, data=a)
             fout.attrs["counts"] = counts
             fout.attrs["n_shards"] = D
+        faults.check("ckpt_rename", path=path)
         os.replace(tmp, path)
+        from ..utils.artifacts import note_artifact_ok
+
+        note_artifact_ok(path)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)

@@ -61,6 +61,7 @@ _seq = 0
 _sink = None                 # open file object, or None
 _sink_path: Optional[str] = None
 _sink_failed = False
+_atexit_registered = False
 
 
 def obs_enabled() -> bool:
@@ -109,7 +110,7 @@ def _json_default(o):
 
 
 def _write(ev: dict) -> None:
-    global _sink, _sink_path, _sink_failed
+    global _sink, _sink_path, _sink_failed, _atexit_registered
     if _sink_failed:
         return
     path = event_path()
@@ -124,6 +125,15 @@ def _write(ev: dict) -> None:
             # as they happen, and a crash loses at most the current line
             _sink = open(path, "a", buffering=1)
             _sink_path = path
+            if not _atexit_registered:
+                # flush-on-exit backstop: the final events of a preempted
+                # or crashing run (checkpoint-written, solver_preempted,
+                # stall_report) must reach rank_<r>/events.jsonl even when
+                # the harness never reaches its explicit flush()
+                import atexit
+
+                atexit.register(flush)
+                _atexit_registered = True
         _sink.write(json.dumps(ev, default=_json_default) + "\n")
     except OSError as e:
         _sink_failed = True  # degrade to in-memory; warn ONCE, not per event

@@ -80,6 +80,7 @@ from ..obs import memory as obs_memory
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
+from ..utils import faults
 from ..utils.config import get_config
 from ..utils.logging import log_debug
 from ..utils.timers import TreeTimer
@@ -116,6 +117,19 @@ def _close_plan_files(files: dict) -> None:
         except Exception:
             pass
     files.clear()
+
+
+def _plan_chunk_crc(pc: dict) -> int:
+    """CRC32 over one (chunk, shard) plan record's arrays in the fixed
+    ``_STREAM_ARRAYS`` order — the per-chunk integrity check the disk tier
+    verifies on every read (a torn/bit-rotted sidecar chunk must trigger
+    the rebuild-from-structure fallback, not corrupt a solve silently)."""
+    import zlib
+
+    c = 0
+    for k in DistributedEngine._STREAM_ARRAYS:
+        c = zlib.crc32(np.ascontiguousarray(pc[k]).tobytes(), c)
+    return c
 
 
 def _bucket_positions(key: jax.Array, D: int) -> jax.Array:
@@ -467,7 +481,14 @@ class DistributedEngine:
                 # streamed: resolve the fused-class structure ONCE (per
                 # construction or artifact-cache restore) into a host-RAM
                 # plan, then stream it back per apply — the orbit scan and
-                # routing math never run again
+                # routing math never run again.  The row provider and
+                # (lazily compiled) build program are KEPT for the
+                # engine's life: a corrupt disk-tier chunk read degrades
+                # to a per-chunk rebuild from structure instead of
+                # crashing a solve mid-apply (DESIGN.md §21).
+                self._row_provider = row_provider
+                self._stream_build_prog = None
+                self._plan_repaired: dict = {}
                 stream_cache = self._resolve_structure_cache(structure_cache)
                 self.structure_restored = agree_restored(
                     self._try_load_stream_plan(stream_cache))
@@ -1052,8 +1073,10 @@ class DistributedEngine:
             # the plan's dest/exchange layout bakes in the row-chunk size
             # and the per-peer capacity; a knob change must miss, not
             # restore a plan whose scatter targets no longer fit
+            # v2: sidecars carry per-(chunk, shard) CRCs (older v1 files
+            # simply miss and rebuild — no mixed-format reads)
             h.update(f"|B{self.batch_size}|cap{self._capacity}"
-                     f"|p{self._lk_probes}|v1".encode())
+                     f"|p{self._lk_probes}|v2".encode())
         self._fp_cache = h.hexdigest()
         return self._fp_cache
 
@@ -1120,7 +1143,9 @@ class DistributedEngine:
                         for k in names:
                             if f"{k}_{d}" in g:
                                 rows[k][d] = g[f"{k}_{d}"][...]
-            except OSError:
+            except OSError as e:
+                from ..utils.artifacts import note_artifact_corrupt
+                note_artifact_corrupt(cand, "structure", e)
                 continue
         need = {"T0", "C"} | ({"W"} if self.mode == "compact" else set())
         if set(my_shards) - found_shards or need - set(scalars):
@@ -1373,23 +1398,16 @@ class DistributedEngine:
                 stage, kind="staging", chunks=int(nchunks))
         self._plan_stage_h = _mem_h
 
-        build = self._make_stream_build()
-
-        def chunk_rows(d, ci):
-            a_d, n_d = row_provider(d)
-            s, e = ci * B, min((ci + 1) * B, M)
-            a, nn = a_d[s:e], n_d[s:e]
-            if e - s < B:
-                a = np.concatenate(
-                    [a, np.full(B - (e - s), SENTINEL_STATE, np.uint64)])
-                nn = np.concatenate([nn, np.ones(B - (e - s))])
-            return a, nn
+        build = self._stream_build_prog
+        if build is None:
+            build = self._stream_build_prog = self._make_stream_build()
 
         def launch(ci):
             a_rows = [None] * D
             n_rows = [None] * D
             for d in my_shards:
-                a_rows[d], n_rows[d] = chunk_rows(d, ci)
+                a_rows[d], n_rows[d] = self._stream_chunk_rows(
+                    row_provider, d, ci)
             a_dev = self._assemble_sharded(a_rows)
             n_dev = self._assemble_sharded(n_rows)
             return build(a_dev, n_dev, self.tables, self._lk_pair,
@@ -1424,7 +1442,13 @@ class DistributedEngine:
             pending = nxt
         self._plan_chunks = chunks
         self._plan_disk = None
-        self._plan_files: dict = {}
+        # keep the SAME dict object across rebuilds — the __init__
+        # weakref.finalize holds a reference to it for close-on-GC
+        files = getattr(self, "_plan_files", None)
+        if files is None:
+            self._plan_files: dict = {}
+        else:
+            _close_plan_files(files)
         self._plan_nchunks_v = nchunks
         self.plan_bytes = plan_bytes
         self._stream_overflow = overflow
@@ -1476,6 +1500,10 @@ class DistributedEngine:
                        "invalid": int(self._stream_invalid)}
             for ci, per in enumerate(self._plan_chunks):
                 for d, pc in per.items():
+                    # per-(chunk, shard) checksum: the disk tier verifies
+                    # it on every read, the RAM restore once — a torn
+                    # sidecar chunk degrades instead of corrupting applies
+                    payload[f"crc_{d}_{ci}"] = _plan_chunk_crc(pc)
                     for k in self._STREAM_ARRAYS:
                         payload[f"{k}_{d}_{ci}"] = pc[k]
             sidecar = self._stream_sidecar(path)
@@ -1568,8 +1596,8 @@ class DistributedEngine:
         for d, cand in where.items():
             by_file.setdefault(cand, []).append(d)
         plan_bytes = 0
-        try:
-            for cand, ds_list in by_file.items():
+        for cand, ds_list in by_file.items():
+            try:
                 with h5py.File(cand, "r") as f:
                     g = f["engine_structure"]
                     for d in ds_list:
@@ -1577,8 +1605,13 @@ class DistributedEngine:
                             for k in self._STREAM_ARRAYS:
                                 ds = g[f"{k}_{d}_{ci}"]
                                 plan_bytes += ds.size * ds.dtype.itemsize
-        except (OSError, KeyError):
-            return False
+            except (OSError, KeyError) as e:
+                # truncated mid-write / bit-rot: a restore-time miss (the
+                # fresh build replaces it) that also feeds the
+                # corrupt/quarantine tally
+                from ..utils.artifacts import note_artifact_corrupt
+                note_artifact_corrupt(cand, "stream_plan", e)
+                return False
         self._plan_nchunks_v = nchunks
         self.plan_bytes = plan_bytes
         self._stream_overflow = scalars["overflow"]
@@ -1594,52 +1627,191 @@ class DistributedEngine:
             self._plan_disk = None
             chunks = [dict() for _ in range(nchunks)]
             for cand, ds_list in by_file.items():
-                with h5py.File(cand, "r") as f:
-                    g = f["engine_structure"]
-                    for d in ds_list:
-                        for ci in range(nchunks):
-                            chunks[ci][d] = {
-                                k: g[f"{k}_{d}_{ci}"][...]
-                                for k in self._STREAM_ARRAYS}
+                try:
+                    with h5py.File(cand, "r") as f:
+                        g = f["engine_structure"]
+                        for d in ds_list:
+                            for ci in range(nchunks):
+                                pc = {k: g[f"{k}_{d}_{ci}"][...]
+                                      for k in self._STREAM_ARRAYS}
+                                crc = g.attrs.get(f"crc_{d}_{ci}")
+                                if crc is not None \
+                                        and _plan_chunk_crc(pc) != int(crc):
+                                    raise ValueError(
+                                        f"stream plan chunk {ci} shard {d} "
+                                        "failed its checksum")
+                                chunks[ci][d] = pc
+                except (OSError, KeyError, ValueError) as e:
+                    from ..utils.artifacts import note_artifact_corrupt
+                    note_artifact_corrupt(cand, "stream_plan", e)
+                    return False
             self._plan_chunks = chunks
             log_debug(f"stream plan restored from {candidates[0]}")
         self._validate_counters(self._stream_overflow,
                                 self._stream_invalid, "streamed")
         return True
 
+    def _stream_chunk_rows(self, row_provider, d: int, ci: int):
+        """Row chunk ``ci`` of shard ``d`` padded to the plan's row-chunk
+        size (SENTINEL rows / unit norms) — shared by the one-time plan
+        build and the per-chunk corrupt-sidecar rebuild so both resolve
+        the identical structure."""
+        a_d, n_d = row_provider(d)
+        B, M = self.batch_size, self.shard_size
+        s, e = ci * B, min((ci + 1) * B, M)
+        a, nn = a_d[s:e], n_d[s:e]
+        if e - s < B:
+            a = np.concatenate(
+                [a, np.full(B - (e - s), SENTINEL_STATE, np.uint64)])
+            nn = np.concatenate([nn, np.ones(B - (e - s))])
+        return a, nn
+
     def _plan_chunk_host(self, ci: int) -> dict:
         """One chunk's host-side plan arrays per addressable shard — from
-        the RAM copy, or read back from the disk-tier sidecar (the OS page
-        cache makes repeated applies stream, not re-read cold)."""
+        the RAM copy, or read back (checksum-verified, retried) from the
+        disk-tier sidecar (the OS page cache makes repeated applies
+        stream, not re-read cold).  A persistently corrupt chunk degrades
+        through :meth:`_degrade_plan_chunk` instead of raising mid-apply."""
         if self._plan_chunks is not None:
             return self._plan_chunks[ci]
+        got = self._plan_repaired.get(ci)
+        if got is not None:
+            return got
+        out = {}
+        for d, path in list(self._plan_disk.items()):
+            try:
+                out[d] = faults.with_retries(
+                    "plan_chunk_read",
+                    lambda: self._read_plan_chunk(path, d, ci),
+                    exc_types=(OSError, KeyError, ValueError))
+            except (OSError, KeyError, ValueError) as e:
+                return self._degrade_plan_chunk(ci, path, e)
+        return out
+
+    def _read_plan_chunk(self, path: str, d: int, ci: int) -> dict:
+        """One (shard, chunk) record from a disk-tier sidecar, with the
+        stored CRC verified (``ValueError`` on mismatch).  EVERY failure
+        drops the cached file handle so the retry reopens fresh — an
+        os.replace-healed sidecar (new inode) is picked up, and a stale
+        handle can't replay the same bad bytes through the backoff."""
+        faults.check("plan_chunk_read", path=path, chunk=ci)
         import h5py
 
-        out = {}
-        for d, path in self._plan_disk.items():
-            f = self._plan_files.get(path)
-            if f is None:
-                f = self._plan_files[path] = h5py.File(path, "r")
+        f = self._plan_files.get(path)
+        if f is None:
+            f = self._plan_files[path] = h5py.File(path, "r")
+        try:
             g = f["engine_structure"]
-            out[d] = {k: g[f"{k}_{d}_{ci}"][...]
-                      for k in self._STREAM_ARRAYS}
-        return out
+            pc = {k: g[f"{k}_{d}_{ci}"][...] for k in self._STREAM_ARRAYS}
+            crc = g.attrs.get(f"crc_{d}_{ci}")
+            if crc is not None and _plan_chunk_crc(pc) != int(crc):
+                raise ValueError(
+                    f"stream plan chunk {ci} shard {d} failed its "
+                    "checksum")
+        except (OSError, KeyError, ValueError):
+            self._plan_files.pop(path, None)
+            try:
+                f.close()
+            except Exception:
+                pass
+            raise
+        return pc
+
+    def _degrade_plan_chunk(self, ci: int, path: str, error) -> dict:
+        """The documented fallback for a corrupt/truncated disk-tier chunk
+        (retries exhausted): count it (``artifact_cache{kind=stream_plan,
+        event=corrupt}``), rebuild THIS chunk's plan from structure, and
+        on the sidecar's second failure quarantine the file and rebuild
+        the whole plan back into host RAM (the disk tier is gone).  Multi-
+        controller runs cannot rebuild rank-locally (the build program is
+        collective) — they fail loudly so the supervisor relaunches and
+        the all-or-nothing restore agreement rebuilds everywhere."""
+        from ..utils.artifacts import note_artifact_corrupt
+        from ..utils.logging import log_warn
+
+        quarantined = note_artifact_corrupt(path, "stream_plan", error)
+        f = self._plan_files.pop(path, None)
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass
+        if self._multi:
+            # OSError, deliberately NOT RuntimeError: the plan_upload
+            # retry wrapper retries RuntimeErrors, and this abort must
+            # propagate on the first pass (re-running the read/degrade
+            # cycle would double-count corruption and quarantine a file
+            # the multi-controller policy says to fail loudly on)
+            raise OSError(
+                f"stream plan sidecar {path} unreadable in a "
+                f"multi-controller run ({error!r}); a rank-local rebuild "
+                "would desynchronize the build collectives — relaunch to "
+                "rebuild the plan on every rank") from error
+        if quarantined:
+            log_warn("stream plan disk tier lost (sidecar quarantined); "
+                     "rebuilding the full plan from structure into host "
+                     "RAM")
+            self._plan_disk = None
+            self._plan_repaired.clear()
+            self._build_stream_plan(self._row_provider)
+            self._register_stream_plan()
+            return self._plan_chunks[ci]
+        per = self._rebuild_plan_chunk(ci)
+        self._plan_repaired[ci] = per
+        return per
+
+    def _rebuild_plan_chunk(self, ci: int) -> dict:
+        """Re-resolve ONE chunk's plan from structure (tables + per-shard
+        lookup are still device-resident in streamed mode) — the same
+        program and row padding as the original build, so the repaired
+        chunk is bit-identical to what the sidecar should have held."""
+        build = self._stream_build_prog
+        if build is None:
+            build = self._stream_build_prog = self._make_stream_build()
+        D = self.n_devices
+        my = [d for d in range(D) if self._shard_addressable(d)]
+        a_rows = [None] * D
+        n_rows = [None] * D
+        for d in my:
+            a_rows[d], n_rows[d] = self._stream_chunk_rows(
+                self._row_provider, d, ci)
+        dest, cf, ridx, rok, _ov, _iv = build(
+            self._assemble_sharded(a_rows), self._assemble_sharded(n_rows),
+            self.tables, self._lk_pair, self._lk_dir)
+        per = {d: {"dest": self._shard_piece(dest, d),
+                   "coeff": self._shard_piece(cf, d),
+                   "ridx": self._shard_piece(ridx, d),
+                   "rok": self._shard_piece(rok, d)} for d in my}
+        emit("plan_chunk_rebuilt", engine="distributed", chunk=int(ci))
+        log_debug(f"stream plan chunk {ci} rebuilt from structure")
+        return per
 
     def _upload_plan_chunk(self, ci: int):
         """Stage one plan chunk onto the mesh ([D, ...] assembled arrays).
         Dispatched one chunk AHEAD of the apply loop so the H2D copy
         overlaps the previous chunk's device pass (the PR-1 double-buffer
-        pattern, now on the apply path)."""
-        per = self._plan_chunk_host(ci)
-        rows = {k: [None] * self.n_devices for k in self._STREAM_ARRAYS}
-        n = 0
-        for d, pc in per.items():
-            for k in self._STREAM_ARRAYS:
-                rows[k][d] = pc[k]
-                n += pc[k].nbytes
+        pattern, now on the apply path).  The upload is idempotent (pure
+        H2D of host-resident arrays), so a transient failure is retried
+        with backoff instead of killing a solve mid-apply."""
+        def _stage():
+            faults.check("plan_upload", exc=RuntimeError, chunk=ci)
+            per = self._plan_chunk_host(ci)
+            rows = {k: [None] * self.n_devices
+                    for k in self._STREAM_ARRAYS}
+            n = 0
+            for d, pc in per.items():
+                for k in self._STREAM_ARRAYS:
+                    rows[k][d] = pc[k]
+                    n += pc[k].nbytes
+            return n, tuple(self._assemble_sharded(rows[k])
+                            for k in self._STREAM_ARRAYS)
+
+        n, staged = faults.with_retries("plan_upload", _stage,
+                                        exc_types=(RuntimeError,))
+        # counted AFTER the retried closure succeeds — a transient failure
+        # mid-stage must not double-count the chunk's bytes
         counter("bytes_h2d", path="plan_stream").inc(n)
-        return tuple(self._assemble_sharded(rows[k])
-                     for k in self._STREAM_ARRAYS)
+        return staged
 
     def _make_streamed_matvec(self):
         D, M, T = self.n_devices, self.shard_size, self.num_terms
@@ -2257,6 +2429,11 @@ class DistributedEngine:
                     f"[D, M, k, 2] (re, im) f64 vectors, got {xh.shape}"
                 )
             raise_deferred_failure(self)
+            # chaos site for the exchange dispatch: fires BEFORE any device
+            # work, so an injected "failed collective" leaves the engine
+            # state intact — the next apply (a supervisor relaunch, or a
+            # caller's retry) runs clean
+            faults.check("exchange", exc=RuntimeError, engine="distributed")
             y, overflow, invalid = self._matvec(xh)
             key = self._last_program_key
             if isinstance(overflow, jax.core.Tracer):

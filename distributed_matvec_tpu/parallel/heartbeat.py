@@ -1,0 +1,171 @@
+"""Cross-rank heartbeat watchdog: turn a hung peer into a diagnosis.
+
+A multi-controller apply is a chain of ``all_to_all``s; when one rank
+wedges (OOM-killed, stuck disk read, dead host) every other rank blocks
+*inside the collective* — silently, forever (or until XLA's own
+rendezvous timeout kills the job with no attribution).  The watchdog runs
+OUTSIDE the collective path: a daemon thread per rank touches
+``<dir>/heartbeat/rank_<r>.hb`` every ``interval_s`` and checks the peers'
+files; when a peer's beat goes stale past ``timeout_s`` it emits a
+``stall_report`` event (per-rank ages — the post-mortem names the hung
+rank), records a critical health condition, flushes the obs sinks, and
+aborts the process (:data:`EXIT_STALLED`) so the supervisor can relaunch
+and resume from the last solver checkpoint instead of holding a slice
+hostage on a dead collective.
+
+The shared directory is typically the obs run dir (multi-rank runs
+already share one); any rank-visible filesystem works.  Off by default —
+``heartbeat_s`` (``DMT_HEARTBEAT_S``) > 0 turns it on, and
+``apps/diagonalize.py`` starts it automatically for multi-process runs
+when armed.  The thread never touches JAX: pure file mtimes, so it keeps
+beating even while the main thread is wedged in a collective — which is
+the whole point.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.logging import _process_count, _process_index, log_warn
+
+__all__ = ["EXIT_STALLED", "HeartbeatWatchdog"]
+
+#: Exit code for a watchdog-detected peer stall (distinct from
+#: EXIT_PREEMPTED: the checkpoint is the *previous* cadence one, not a
+#: fresh safe-point write).
+EXIT_STALLED = 76
+
+
+def _default_on_stall(report: dict) -> None:
+    log_warn(f"peer rank(s) stalled: {report['stalled']} "
+             f"(ages {report['ages_s']}, timeout {report['timeout_s']} s); "
+             "aborting so the supervisor can relaunch and resume")
+    # os._exit, not sys.exit: the main thread is (by hypothesis) wedged in
+    # a collective and will never unwind a SystemExit raised here
+    os._exit(EXIT_STALLED)
+
+
+class HeartbeatWatchdog:
+    """File-based liveness monitor for one rank of a multi-controller job.
+
+    ``start()`` launches the daemon thread; ``stop()`` joins it (also a
+    context manager).  ``rank``/``n_ranks`` default to the JAX process
+    topology but are injectable so a single process can be tested against
+    fabricated peers.  ``on_stall`` (default: emit + flush + abort) is
+    called at most once with the report dict."""
+
+    def __init__(self, directory: str, interval_s: float = 2.0,
+                 timeout_s: float = 60.0,
+                 rank: Optional[int] = None,
+                 n_ranks: Optional[int] = None,
+                 on_stall: Optional[Callable[[dict], None]] = None):
+        self.dir = os.path.join(directory, "heartbeat")
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.rank = _process_index() if rank is None else int(rank)
+        self.n_ranks = _process_count() if n_ranks is None else int(n_ranks)
+        self.on_stall = on_stall or _default_on_stall
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stalled = False
+        self._t0 = time.time()
+
+    # -- beat + scan ----------------------------------------------------
+
+    def _path(self, r: int) -> str:
+        return os.path.join(self.dir, f"rank_{r}.hb")
+
+    def beat(self) -> None:
+        """Touch this rank's beat file (atomic replace: a reader never
+        sees a half-written beat)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self._path(self.rank) + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{time.time():.3f}\n")
+            os.replace(tmp, self._path(self.rank))
+        except OSError as e:
+            # a full/readonly shared dir must not kill a healthy rank —
+            # peers will see THIS rank as stale, which is the honest signal
+            log_warn(f"heartbeat write failed: {e!r}")
+
+    def scan(self) -> Optional[dict]:
+        """Peer ages; a stall report dict when any peer exceeds the
+        timeout, else None.  A peer whose file never appeared — or whose
+        beat PREDATES this watchdog (a leftover from a previous run in
+        the same dir: a relaunch-after-preemption must not be killed by
+        its own dead predecessor's files) — is only counted stale once
+        the watchdog itself has been alive past the timeout (startup
+        grace: ranks come up at different times)."""
+        now = time.time()
+        ages = {}
+        stalled = []
+        grace_over = (now - self._t0) > self.timeout_s
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                mtime = os.path.getmtime(self._path(r))
+            except OSError:
+                mtime = None
+            if mtime is None or mtime < self._t0:
+                if not grace_over:
+                    continue
+                age = now - self._t0
+            else:
+                age = now - mtime
+            ages[str(r)] = round(age, 1)
+            if age > self.timeout_s:
+                stalled.append(r)
+        if not stalled:
+            return None
+        return {"rank": self.rank, "stalled": stalled, "ages_s": ages,
+                "timeout_s": self.timeout_s}
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            report = self.scan()
+            if report is not None and not self._stalled:
+                self._stalled = True
+                try:
+                    from ..obs import health as obs_health
+                    from ..obs.events import emit, flush
+
+                    emit("stall_report", **report)
+                    if obs_health.probes_enabled():
+                        obs_health.record("peer_stall", "critical",
+                                          **report)
+                    flush()
+                except Exception:
+                    pass
+                self.on_stall(report)
+                return
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatWatchdog":
+        if self.n_ranks <= 1:
+            return self          # nothing to watch — stay inert
+        if self._thread is None:
+            self.beat()          # first beat synchronously: peers see us
+            self._thread = threading.Thread(
+                target=self._loop, name="dmt-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "HeartbeatWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

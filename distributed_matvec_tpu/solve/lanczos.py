@@ -42,7 +42,8 @@ from scipy.linalg import eigh
 
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
-from ..obs.events import emit as obs_emit, obs_enabled
+from ..obs.events import emit as obs_emit, flush as obs_flush, obs_enabled
+from ..utils import faults, preempt
 
 __all__ = ["LanczosResult", "lanczos", "lanczos_block"]
 
@@ -286,6 +287,31 @@ def _save_ckpt(path, fp, owner, V, meta, m, sharded) -> None:
         del r
     save_hashed_vectors(path, rows, owner.counts,
                         meta=dict(meta, fingerprint=fp))
+
+
+def _soft_save_ckpt(path, fp, owner, V, meta, m, sharded,
+                    solver: str = "lanczos", reason: str = "cadence") -> bool:
+    """A checkpoint write that cannot kill the solve it protects: failures
+    (full disk, read-only checkout, injected ``ckpt_write``/``ckpt_rename``
+    faults) degrade to one ``log_warn`` plus a
+    ``solver_checkpoint{status=failed}`` event — a run hundreds of
+    iterations deep keeps going and tries again at the next cadence.
+    Success emits the ``solver_checkpoint`` event the chaos gate and a
+    post-mortem read to locate the last good generation."""
+    try:
+        _save_ckpt(path, fp, owner, V, meta, m, sharded)
+    except OSError as e:
+        from ..utils.logging import log_warn
+        log_warn(f"{solver} checkpoint save failed ({e!r}); "
+                 "solve continues without this generation")
+        obs_emit("solver_checkpoint", solver=solver, status="failed",
+                 reason=reason, path=str(path), error=repr(e),
+                 iters=int(meta.get("total_iters", 0)))
+        return False
+    obs_emit("solver_checkpoint", solver=solver, status="written",
+             reason=reason, path=str(path),
+             iters=int(meta.get("total_iters", 0)))
+    return True
 
 
 def _restore_ckpt(path, fp, owner, shape, sharded):
@@ -649,6 +675,9 @@ def lanczos_block(
     first_block_iters = 0
     steady_s = 0.0
     watchdog = _Watchdog("lanczos_block")
+    preempt.ensure_installed()
+    agree_multi = jax.process_count() > 1 and (
+        owner is None or bool(getattr(owner, "_multi", True)))
     obs_emit("solver_start", solver="lanczos_block", k=int(k),
              block_size=int(p), max_iters=int(max_iters), tol=float(tol))
 
@@ -663,6 +692,18 @@ def lanczos_block(
                                  block_size=int(p))
 
     for j in range(max_blocks):
+        faults.check("solver_block", exc=RuntimeError,
+                     solver="lanczos_block", iter=int(total))
+        # safe point between block steps (no checkpoint machinery here —
+        # the block basis is unbounded; the exit is still clean and agreed
+        # so a preempted streamed solve dies at a block boundary, not
+        # inside a half-streamed plan pass)
+        if preempt.agreed(agree_multi):
+            obs_emit("solver_preempted", solver="lanczos_block",
+                     iters=int(total), checkpoint="")
+            obs_flush()
+            mem_h.release()
+            raise preempt.Preempted("lanczos_block", total, None)
         t0 = _time.perf_counter()
         Qj = blocks[-1]
         # step 0 reuses the probe's apply (timed via probe_s below)
@@ -1013,6 +1054,12 @@ def lanczos(
     first_block_iters = 0
     steady_s = 0.0
     watchdog = _Watchdog("lanczos")
+    preempt.ensure_installed()
+    # the preemption latch needs cross-rank agreement only when the
+    # solve's collectives actually span processes — a rank-local-mesh
+    # engine in a multi-process job preempts independently
+    agree_multi = multi and (owner is None
+                             or bool(getattr(owner, "_multi", True)))
     obs_emit("solver_start", solver="lanczos", k=int(k),
              max_iters=int(max_iters), tol=float(tol), pair=bool(pair),
              max_basis_size=int(mcap), resumed_from=int(resumed_from),
@@ -1134,13 +1181,37 @@ def lanczos(
         watchdog.check_stagnation(res, total_iters)
 
         blocks_done += 1
-        if checkpoint_path and blocks_done % max(checkpoint_every, 1) == 0:
-            _save_ckpt(checkpoint_path, ckpt_fp, owner, V, {
-                "alph": np.asarray(alph_d), "bet": np.asarray(bet_d),
-                "lock_theta": np.asarray(lock_theta),
-                "lock_sigma": np.asarray(lock_sigma),
-                "m": int(m), "total_iters": int(total_iters)},
-                m, sharded_ckpt)
+        # chaos site at the block boundary: `delay=` stretches a solve so
+        # the chaos gate can land a kill mid-iteration deterministically;
+        # inert (shared no-op) when DMT_FAULT is unset
+        faults.check("solver_block", exc=RuntimeError, solver="lanczos",
+                     iter=int(total_iters))
+        # safe point: the recurrence state is host-consistent and no
+        # collective is in flight — the latch verdict is agreed across
+        # ranks so every rank checkpoints the SAME generation and exits
+        # together (DESIGN.md §21).  ckpt_meta (four D2H fetches) is built
+        # only when a save actually happens — the plain hot loop pays
+        # nothing here.
+        cadence_due = bool(checkpoint_path) \
+            and blocks_done % max(checkpoint_every, 1) == 0
+        preempted = preempt.agreed(agree_multi)
+        if cadence_due or (preempted and checkpoint_path):
+            _soft_save_ckpt(
+                checkpoint_path, ckpt_fp, owner, V, {
+                    "alph": np.asarray(alph_d), "bet": np.asarray(bet_d),
+                    "lock_theta": np.asarray(lock_theta),
+                    "lock_sigma": np.asarray(lock_sigma),
+                    "m": int(m), "total_iters": int(total_iters)},
+                m, sharded_ckpt,
+                reason="cadence" if cadence_due else "preempt")
+        if preempted:
+            obs_emit("solver_preempted", solver="lanczos",
+                     iters=int(total_iters),
+                     checkpoint=checkpoint_path or "")
+            obs_flush()
+            mem_h.release()
+            raise preempt.Preempted("lanczos", total_iters,
+                                    checkpoint_path)
 
     kk = min(k, m)
     evecs = None

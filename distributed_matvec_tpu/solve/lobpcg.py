@@ -36,7 +36,9 @@ import numpy as np
 
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
-from ..obs.events import emit as obs_emit, obs_enabled
+from ..obs.events import emit as obs_emit, flush as obs_flush, obs_enabled
+from ..utils import preempt
+from .lanczos import _operator_key, _restore_ckpt, _soft_save_ckpt
 
 __all__ = ["lobpcg"]
 
@@ -80,7 +82,9 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
            X0: Optional[np.ndarray] = None,
            pair: Optional[bool] = None,
            cluster_rtol: float = 1e-6,
-           rank_tol: float = 0.3
+           rank_tol: float = 0.3,
+           checkpoint_path: Optional[str] = None,
+           checkpoint_every: int = 50
            ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Lowest-``k`` eigenpairs via spectrum-flipped LOBPCG.
 
@@ -101,6 +105,21 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
     residual exceeds ``rank_tol`` — so a near-threshold residual on one
     column cannot silently drop a genuine degenerate partner the way a
     fixed per-column scalar cutoff could.
+
+    ``checkpoint_path`` brings LOBPCG to checkpoint/resume parity with
+    :func:`~.lanczos.lanczos`: the iteration is driven in *segments* of
+    ``checkpoint_every`` iterations, the current block is snapshotted
+    after each segment (atomically, keyed by (dim, block, operator) via
+    the same fingerprint/sharded-snapshot machinery as Lanczos — each
+    rank of a multi-controller run writes its addressable shards, and
+    restore is generation-agreed across ranks), and a rerun with the same
+    arguments warm-starts from the last saved block with the cumulative
+    iteration count.  LOBPCG restarted from its own block loses only the
+    implicit momentum direction of the segment boundary — convergence
+    continues, it does not restart.  A latched preemption signal exits at
+    a segment boundary (checkpoint written) with
+    :class:`~..utils.preempt.Preempted`.  Without ``checkpoint_path`` the
+    solve runs one-shot, exactly as before.
     """
     from jax.experimental.sparse.linalg import lobpcg_standard
 
@@ -157,17 +176,72 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
                 "multi-process LOBPCG cannot consume a global warm-start "
                 "X0; run without X0 or use solve.lanczos")
 
+    preempt.ensure_installed()
+
+    def _ckpt_fp(dim_, cols):
+        """Checkpoint identity: vector space + block width + operator —
+        the same keying contract as the Lanczos checkpoints (a rerun
+        against an edited Hamiltonian of the same size misses instead of
+        restoring a foreign block)."""
+        return f"lobpcg|{dim_}|{cols}|{int(bool(pair))}" \
+               f"|{_operator_key(owner)}|v1"
+
+    def _exit_preempted(done):
+        obs_emit("solver_preempted", solver="lobpcg", iters=int(done),
+                 checkpoint=checkpoint_path or "")
+        obs_flush()
+        mem_h.release()
+        raise preempt.Preempted("lobpcg", done, checkpoint_path)
+
     def run_flipped(mv, dim_, U0):
         """sigma estimate, spectrum-flipped lobpcg_standard, ascending
-        (evals, columns, iters) output: the scaffold every branch shares."""
+        (evals, columns, iters) output: the scaffold every branch shares.
+        With ``checkpoint_path`` the call is segmented (see docstring);
+        single-controller, so the snapshot is the flat block itself."""
         sigma = _norm_estimate(mv, dim_)
+        flip = lambda X: sigma * X - mv(X)            # noqa: E731
         U0q, _ = np.linalg.qr(np.asarray(U0))
-        theta, U, iters = lobpcg_standard(
-            lambda X: sigma * X - mv(X), jnp.asarray(U0q),
-            m=max_iters, tol=tol)
+        X = jnp.asarray(U0q)
+        cols = int(X.shape[1])
+        done = 0
+        if checkpoint_path:
+            fp = _ckpt_fp(dim_, cols)
+            got = _restore_ckpt(checkpoint_path, fp, None, X.shape,
+                                sharded=False)
+            if got is not None and len(got["V_rows"]) == cols:
+                X = jnp.stack(got["V_rows"], axis=1).astype(X.dtype)
+                done = int(got["total_iters"])
+                obs_emit("solver_resume", solver="lobpcg",
+                         iters=int(done), path=checkpoint_path)
+        theta = U = None
+        if done >= max_iters:
+            # resume with the budget already spent: return the restored
+            # block's Rayleigh-Ritz estimates without iterating (the
+            # lanczos restore-path contract — the cap is never exceeded)
+            G = np.asarray(X.conj().T @ flip(X))
+            theta, W = np.linalg.eigh((G + G.conj().T) / 2)
+            U = np.asarray(X @ jnp.asarray(W))
+        while done < max_iters:
+            seg = (max_iters - done) if not checkpoint_path else \
+                min(max(int(checkpoint_every), 1), max_iters - done)
+            theta, U, it = lobpcg_standard(flip, X, m=seg, tol=tol)
+            done += int(it)
+            X = U
+            if not checkpoint_path:
+                break
+            _soft_save_ckpt(checkpoint_path, fp, None,
+                            jnp.swapaxes(U, 0, 1),
+                            {"m": cols - 1, "total_iters": int(done)},
+                            cols - 1, sharded=False, solver="lobpcg")
+            # lobpcg_standard breaks early on convergence, so a full
+            # segment (it == seg) means "not converged yet"
+            if int(it) < seg:
+                break
+            if preempt.agreed(False):
+                _exit_preempted(done)
         evals = sigma - np.asarray(theta)
         order = np.argsort(evals)
-        return sigma, evals[order], np.asarray(U)[:, order], int(iters)
+        return sigma, evals[order], np.asarray(U)[:, order], int(done)
 
     def raw_mv(x):
         y = matvec(x)
@@ -229,10 +303,12 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         def run_flipped_multi(U0):
             """Multi-process scaffold: eager hashed power iteration for
             sigma (also runs the engine's counter validation), Gram +
-            Cholesky orthonormalization of the sharded start block (the
-            [m, m] Gram is a psum-reduced matmul, replicated on every
-            rank), then the unjitted LOBPCG body under one jit with the
-            engine operands as arguments."""
+            Cholesky orthonormalization of the sharded block (the [m, m]
+            Gram is a psum-reduced matmul, replicated on every rank), then
+            the unjitted LOBPCG body under one jit with the engine
+            operands as arguments — segmented per ``checkpoint_every``
+            when checkpointing, with per-rank shard snapshots and the
+            generation-agreed restore of the Lanczos machinery."""
             vh = owner.random_hashed(seed=seed + 1)
             lam = 0.0
             for _ in range(20):
@@ -249,28 +325,127 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             # on every process.
             from jax.sharding import NamedSharding, PartitionSpec
             _rep = NamedSharding(owner.mesh, PartitionSpec())
-            G = np.asarray(
-                jax.jit(lambda A: A.T @ A, out_shardings=_rep)(U0))
-            L = np.linalg.cholesky(
-                G + 1e-12 * np.trace(G) * np.eye(G.shape[1]))
-            Li = jnp.asarray(np.linalg.inv(L))
+
+            # hoisted jitted helpers: a fresh jit(lambda) per segment
+            # would miss jax's trace cache and recompile every checkpoint
+            # segment
+            _gram = jax.jit(lambda A: A.T @ A, out_shardings=_rep)
+            _snap = jax.jit(lambda u: jnp.moveaxis(from_flat(u), 2, 0))
+
+            def gram_li(X):
+                G = np.asarray(_gram(X))
+                L = np.linalg.cholesky(
+                    G + 1e-12 * np.trace(G) * np.eye(G.shape[1]))
+                return jnp.asarray(np.linalg.inv(L))
+
             apply_fn, operands = owner.bound_matvec()
 
             def mv_ops(Xb, ops):
                 Y = apply_fn(from_flat(Xb), ops)
                 return to_flat(Y[0] if isinstance(Y, tuple) else Y)
 
-            @jax.jit
-            def _run(X, Li_, ops):
-                Xq = X @ Li_.T
-                return raw_lobpcg(
-                    lambda Xb: sigma * Xb - mv_ops(Xb, ops),
-                    Xq, max_iters, tol, False)
+            _progs: dict = {}
 
-            theta, U, iters = _run(U0, Li, operands)
+            def _run(X, Li_, ops, m_seg):
+                f = _progs.get(m_seg)
+                if f is None:
+                    def _body(X, Li_, ops):
+                        Xq = X @ Li_.T
+                        return raw_lobpcg(
+                            lambda Xb: sigma * Xb - mv_ops(Xb, ops),
+                            Xq, m_seg, tol, False)
+                    f = _progs[m_seg] = jax.jit(_body)
+                return f(X, Li_, ops)
+
+            cols = int(U0.shape[1])
+            X = U0
+            done = 0
+            fp = _ckpt_fp(dim, cols)
+            # rank-local-mesh engines inside a multi-process job (the CPU
+            # test rig) solve independently — no cross-rank agreement
+            # collectives, same gating as lanczos's agree_multi
+            agree = bool(getattr(owner, "_multi", True))
+            if checkpoint_path:
+                got = _restore_block_multi(fp, cols, agree)
+                if got is not None:
+                    X, done = got
+                    obs_emit("solver_resume", solver="lobpcg",
+                             iters=int(done), path=checkpoint_path)
+            theta = U = None
+            if done >= max_iters:
+                # budget already spent at restore: Rayleigh-Ritz estimates
+                # from the saved block, no further iterations (the psum'd
+                # Gram lands replicated like gram_li's)
+                G = np.asarray(jax.jit(
+                    lambda Xb, ops: Xb.T @ (sigma * Xb - mv_ops(Xb, ops)),
+                    out_shardings=_rep)(X, operands))
+                theta, W = np.linalg.eigh((G + G.T) / 2)
+                U = jax.jit(jnp.matmul)(X, jnp.asarray(W))
+            while done < max_iters:
+                seg = (max_iters - done) if not checkpoint_path else \
+                    min(max(int(checkpoint_every), 1), max_iters - done)
+                theta, U, it = _run(X, gram_li(X), operands, seg)
+                done += int(it)
+                X = U
+                if not checkpoint_path:
+                    break
+                # columns → hashed rows [cols, D, M(, 2)] for the
+                # per-shard snapshot (every op on the process-spanning
+                # block stays under jit)
+                V = _snap(U)
+                _soft_save_ckpt(checkpoint_path, fp, owner, V,
+                                {"m": cols - 1,
+                                 "total_iters": int(done)},
+                                cols - 1, sharded=True, solver="lobpcg")
+                if int(it) < seg:
+                    break
+                if preempt.agreed(agree):
+                    _exit_preempted(done)
             evals = sigma - np.asarray(theta)
             order = np.argsort(evals)
-            return sigma, evals[order], U[:, jnp.asarray(order)], int(iters)
+            return sigma, evals[order], U[:, jnp.asarray(order)], int(done)
+
+        def _restore_block_multi(fp, cols, agree):
+            """Per-shard block restore + the cross-rank generation
+            agreement (per-rank snapshot files are written without a
+            barrier; resuming from mixed generations would desynchronize
+            the SPMD programs — all ranks agree or all start fresh;
+            ``agree=False`` = rank-local mesh, local verdict only)."""
+            from ..io.sharded_io import load_hashed_meta, load_hashed_shard
+
+            meta = load_hashed_meta(checkpoint_path,
+                                    expected_fingerprint=fp)
+            got = None
+            if meta is not None and int(meta["m"]) == cols - 1:
+                D_, M_ = owner.n_devices, owner.shard_size
+                tail = (2,) if pair else ()
+                pieces = [None] * D_
+                try:
+                    for d in range(D_):
+                        if not owner._shard_addressable(d):
+                            continue
+                        buf = np.zeros((M_, cols) + tail)
+                        for i in range(cols):
+                            r = load_hashed_shard(
+                                checkpoint_path, d, name=f"krylov_{i}",
+                                expected_fingerprint=fp)
+                            buf[: r.shape[0], i] = r
+                        pieces[d] = buf
+                    got = (owner._assemble_sharded(pieces),
+                           int(meta["total_iters"]))
+                except KeyError:
+                    got = None
+            if agree:
+                from jax.experimental import multihost_utils as _mhu
+                tok = np.array([got[1] if got is not None else -1],
+                               np.int64)
+                all_tok = _mhu.process_allgather(tok)
+                if not (all_tok >= 0).all() \
+                        or not (all_tok == all_tok[0]).all():
+                    return None
+            if got is None:
+                return None
+            return jax.jit(to_flat)(got[0]), got[1]
 
     if not pair:
         if dist:

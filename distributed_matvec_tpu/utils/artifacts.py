@@ -52,6 +52,8 @@ __all__ = [
     "ensure_compilation_cache",
     "within_size_cap",
     "record_cache_event",
+    "note_artifact_corrupt",
+    "quarantine_artifact",
 ]
 
 
@@ -66,6 +68,80 @@ def record_cache_event(kind: str, event: str) -> None:
 
 _DEFAULT_ROOT = os.path.join(os.path.expanduser("~"), ".cache",
                              "distributed_matvec_tpu", "artifacts")
+
+# per-path corrupt-read tally for the retry/quarantine policy (DESIGN.md
+# §21): one failure is counted (transient disks happen), a second moves
+# the file out of the cache's way
+_read_failures: dict = {}
+
+
+def note_artifact_ok(path: str) -> None:
+    """Clear the corruption tally for ``path`` — called by the atomic
+    save paths after a successful write, so a rebuilt-and-re-saved
+    artifact starts with a clean record (one later transient failure must
+    not quarantine a healed file)."""
+    _read_failures.pop(path, None)
+
+
+def note_artifact_corrupt(path: str, kind: str, error=None) -> bool:
+    """Record a corrupt/unreadable artifact read and apply the quarantine
+    policy: every failure bumps ``artifact_cache{kind=...,event=corrupt}``
+    and emits an ``artifact_cache`` corrupt event; the SECOND failure on
+    the same path moves the file into a ``.quarantine/`` sibling directory
+    (:func:`quarantine_artifact`) so the cache stops serving it — the
+    caller's rebuild-from-structure fallback then becomes permanent for
+    that entry instead of retrying a bad file forever.  Returns True when
+    the file was quarantined."""
+    record_cache_event(kind, "corrupt")
+    try:
+        from ..obs.events import emit
+
+        # NB: "kind" is an envelope key — the artifact kind rides as
+        # artifact_kind (same convention as the counter's labels)
+        emit("artifact_cache", artifact_kind=kind, event="corrupt",
+             path=path, error=repr(error))
+    except Exception:
+        pass
+    n = _read_failures.get(path, 0) + 1
+    _read_failures[path] = n
+    if n < 2:
+        log_warn(f"corrupt {kind} artifact {path} ({error!r}); rebuilding "
+                 "— a second failure will quarantine the file")
+        return False
+    return quarantine_artifact(path, kind, reason=repr(error))
+
+
+def quarantine_artifact(path: str, kind: str, reason: str = "") -> bool:
+    """Move a bad artifact into ``.quarantine/`` next to it (same
+    filesystem, atomic rename) and emit an ``artifact_quarantine`` event.
+    Fails soft: an unmovable file logs one warning and stays — readers
+    already treat it as a miss."""
+    if not os.path.exists(path):
+        return False
+    qdir = os.path.join(os.path.dirname(os.path.abspath(path)),
+                        ".quarantine")
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, os.path.basename(path))
+        i = 1
+        while os.path.exists(dest):
+            dest = os.path.join(qdir, f"{os.path.basename(path)}.{i}")
+            i += 1
+        os.replace(path, dest)
+    except OSError as e:
+        log_warn(f"quarantine of {path} failed: {e!r}")
+        return False
+    _read_failures.pop(path, None)
+    record_cache_event(kind, "quarantine")
+    try:
+        from ..obs.events import emit
+
+        emit("artifact_quarantine", artifact_kind=kind, path=path,
+             moved_to=dest, reason=reason)
+    except Exception:
+        pass
+    log_warn(f"quarantined corrupt {kind} artifact: {path} -> {dest}")
+    return True
 
 
 def artifacts_enabled() -> bool:
@@ -195,10 +271,21 @@ def make_or_restore_basis(basis, path: Optional[str] = None,
         log_debug(f"basis artifact cache disabled (no HDF5 I/O): {e!r}")
         basis.build()
         return False
+    from . import faults
+
+    def _load():
+        if os.path.exists(path):
+            faults.check("artifact_read", path=path)
+        return load_basis(path)
+
     try:
-        got = load_basis(path)
-    except OSError:
+        # bounded retry: a transient read blip must not cost a rebuild;
+        # a persistently corrupt checkpoint falls through to the rebuild
+        # path AND the corrupt/quarantine tally
+        got = faults.with_retries("artifact_read", _load)
+    except OSError as e:
         got = None          # truncated/corrupt checkpoint: rebuild
+        note_artifact_corrupt(path, "basis", e)
     if got is not None and got[1] is not None:
         reps, norms = got
         basis.unchecked_set_representatives(reps, norms)
@@ -219,6 +306,7 @@ def make_or_restore_basis(basis, path: Optional[str] = None,
     try:
         import tempfile
 
+        faults.check("artifact_save", path=path)
         d = os.path.dirname(os.path.abspath(path))
         fd, tmp = tempfile.mkstemp(suffix=".h5.tmp", dir=d)
         os.close(fd)
@@ -226,6 +314,7 @@ def make_or_restore_basis(basis, path: Optional[str] = None,
         try:
             save_basis(tmp, basis.representatives, basis.norms)
             os.replace(tmp, path)
+            note_artifact_ok(path)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -99,6 +99,27 @@ class RuntimeConfig:
     #   reorth-bound at ~26× the apply cost) | "full" (the pre-round-9
     #   behavior: full MGS sweeps every iteration)
 
+    # -- fault tolerance (utils/faults.py / preempt.py, parallel/heartbeat.py)
+    fault: str = ""                        # deterministic fault injection
+    #   (DMT_FAULT): "site[:p=..][:n=..][:skip=..][:seed=..][:delay=..],..."
+    #   arms named failure sites on the I/O and comms edges; empty (the
+    #   default) resolves to a shared no-op registry — provably inert,
+    #   same guard style as DMT_OBS=off
+    io_retries: int = 3                    # bounded retry attempts for
+    #   idempotent I/O reads (disk-tier plan chunks, artifact loads);
+    #   backoff doubles from io_retry_base_s per attempt
+    io_retry_base_s: float = 0.05
+    heartbeat_s: float = 0.0               # >0 → cross-rank heartbeat
+    #   watchdog beat interval (DMT_HEARTBEAT_S); a peer rank whose beat
+    #   goes stale past heartbeat_timeout_s triggers a stall_report event
+    #   + abort (EXIT_STALLED) instead of an infinite all_to_all wait
+    heartbeat_timeout_s: float = 120.0
+    preempt: str = "auto"                  # SIGTERM/SIGINT preemption latch
+    #   (DMT_PREEMPT): "auto" installs checkpoint-and-exit handlers around
+    #   solves (apps/diagonalize exits EXIT_PREEMPTED=75 so a supervisor
+    #   relaunches the same argv and resumes); "off" leaves signal
+    #   dispositions alone
+
     # -- artifact cache (utils/artifacts.py) --------------------------------
     artifact_cache: str = "on"             # default-on content-addressed
     #   cache of basis representatives, engine structure sidecars, and the

@@ -1,0 +1,154 @@
+"""Preemption-safe solves: SIGTERM/SIGINT latch + the ``Preempted`` exit.
+
+Preemptible TPU slices (the operating regime of the source paper) deliver
+a SIGTERM and a short grace window; a multi-hour Lanczos solve must turn
+that into a *generation-consistent checkpoint* and a distinct exit code,
+not a torn process.  The contract:
+
+* :func:`ensure_installed` installs latch-setting handlers — no I/O, no
+  locks, nothing a signal context can deadlock on.  Installed
+  process-wide (idempotent, main thread only, ``DMT_PREEMPT=off`` to opt
+  out) and deliberately NOT uninstalled after a solve: in a multi-solve
+  driver a signal landing *between* solves must still latch, so the next
+  safe point exits preempted instead of the default disposition killing
+  an un-checkpointed process.  The solver loops install **SIGTERM only**
+  (the actual preemption signal) so a library user's Ctrl-C keeps its
+  ordinary KeyboardInterrupt semantics; ``apps/diagonalize.py`` — a batch
+  driver — opts SIGINT into the latch too.
+* The solver checks :func:`requested` at a *safe point* — the block
+  boundary, where the Krylov recurrence state is host-consistent and no
+  collective is in flight — agrees on the verdict across ranks
+  (:func:`agreed`, the same allgather protocol as the checkpoint-restore
+  generation agreement, DESIGN.md §15/§21), writes a checkpoint on every
+  rank, flushes the obs sinks, and raises :class:`Preempted`.
+* ``apps/diagonalize.py`` catches :class:`Preempted` and exits
+  :data:`EXIT_PREEMPTED` (75, ``EX_TEMPFAIL``: "transient, retry") so a
+  supervisor can relaunch the SAME argv and resume from the checkpoint.
+
+A second signal while the latch is already set restores the default
+disposition and re-raises it — a stuck checkpoint write can always be
+killed the ordinary way.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+__all__ = ["EXIT_PREEMPTED", "Preempted", "ensure_installed", "requested",
+           "agreed", "trigger", "reset"]
+
+#: Distinct exit code for a checkpoint-and-exit preemption (EX_TEMPFAIL:
+#: transient failure, relaunch with the same argv to resume).
+EXIT_PREEMPTED = 75
+
+_latch = False
+_signum: Optional[int] = None
+_prev: dict = {}
+
+
+class Preempted(Exception):
+    """A solve stopped at a safe point in response to a preemption signal
+    (or a programmatic :func:`trigger`).  ``checkpoint_path`` is the
+    checkpoint the resume should restore from (None when the solve ran
+    without one)."""
+
+    def __init__(self, solver: str, iters: int,
+                 checkpoint_path: Optional[str] = None):
+        self.solver = solver
+        self.iters = int(iters)
+        self.checkpoint_path = checkpoint_path
+        where = f" (checkpoint: {checkpoint_path})" if checkpoint_path \
+            else " (no checkpoint configured)"
+        super().__init__(
+            f"{solver} preempted at iteration {iters}{where}; relaunch "
+            f"with the same arguments to resume")
+
+
+def _handler(signum, frame):
+    global _latch, _signum
+    if _latch:
+        # second signal: the graceful path is already in progress (or
+        # stuck) — restore the default disposition and deliver it
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+        return
+    _latch = True
+    _signum = signum
+
+
+def ensure_installed(signals=(signal.SIGTERM,)) -> bool:
+    """Install the latch handlers process-wide (idempotent per signal).
+    The default covers SIGTERM only — the preemption signal — so library
+    solves never change a user's Ctrl-C semantics; the CLI driver passes
+    SIGINT too.  Main thread only — signal dispositions cannot be set
+    elsewhere; a worker-thread caller still reads a latch set by a
+    main-thread installation or :func:`trigger`.  ``DMT_PREEMPT=off`` (or
+    config ``preempt="off"``) opts out for embeddings with their own
+    signal plumbing.  Returns True when all requested handlers are (now)
+    active."""
+    from .config import get_config
+
+    knob = os.environ.get("DMT_PREEMPT")
+    if knob is None:
+        knob = get_config().preempt
+    if str(knob).strip().lower() in ("off", "0", "false", "no"):
+        return False
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    ok = True
+    for s in signals:
+        if s in _prev:
+            continue
+        try:
+            _prev[s] = signal.signal(s, _handler)
+        except (ValueError, OSError):   # exotic embedding: leave as-is
+            ok = False
+    return ok
+
+
+def requested() -> bool:
+    """Whether a preemption signal has been latched (this process)."""
+    return _latch
+
+
+def signal_number() -> Optional[int]:
+    return _signum
+
+
+def agreed(multi: bool) -> bool:
+    """Cross-rank verdict on the latch: in a multi-controller run every
+    rank must take the checkpoint-and-exit branch *together* or the
+    survivors hang in the next collective, so the local flags are
+    max-reduced over the same allgather protocol the checkpoint restore
+    uses.  ``multi=False`` (single controller, or rank-local meshes whose
+    collectives never cross processes) returns the local latch."""
+    if not multi:
+        return _latch
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils as mhu
+
+        return bool(np.max(mhu.process_allgather(np.int32(_latch))))
+    except Exception:
+        # backends without cross-process host collectives: the local
+        # verdict is all we have (rank-local-mesh rigs land here and their
+        # solves are process-local anyway)
+        return _latch
+
+
+def trigger() -> None:
+    """Programmatically set the latch (tests, embedding harnesses with
+    their own signal plumbing)."""
+    global _latch
+    _latch = True
+
+
+def reset() -> None:
+    """Clear the latch (tests; a resumed in-process solve after a handled
+    ``Preempted``)."""
+    global _latch, _signum
+    _latch = False
+    _signum = None

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""fault-check — the chaos gate for the fault-tolerance layer
+(`make fault-check`).
+
+Drives `apps/diagonalize.py` on a 2-virtual-device chain_12 rig and
+asserts the ROADMAP's bit-consistency acceptance as a repeatable gate:
+
+1. **Preemption (SIGTERM)** — a solve stretched by the `solver_block`
+   delay fault is killed mid-iteration; it must exit
+   ``EXIT_PREEMPTED`` (75) after writing a safe-point checkpoint and a
+   ``solver_preempted`` event, and a relaunch with the SAME argv must
+   resume (``resumed from N`` on stdout) and land E0 within rtol 1e-12
+   of an uninterrupted run.
+2. **Hard kill (SIGKILL)** — no grace window at all: the relaunch
+   resumes from the last *cadence* checkpoint with the same E0 bound.
+3. **Fault sites, each injected separately** (deterministic seeds):
+   - ``artifact_read`` — a failed basis-checkpoint read retries and heals;
+   - ``ckpt_write`` + ``ckpt_rename`` — failed checkpoint saves degrade
+     softly (the solve completes anyway);
+   - ``exchange`` — an injected collective failure aborts the apply
+     cleanly and the next apply runs bit-identically (in-process leg);
+   - ``plan_upload`` — a failed H2D plan stage retries and the streamed
+     apply completes bit-identical to fused (in-process leg);
+   - ``plan_chunk_read`` — a transient disk-tier read heals by retry, and
+     a *corrupt* sidecar chunk (checksum mismatch) rebuilds that chunk's
+     plan from structure, bit-identical (in-process leg).
+
+Every leg compares E0 (or the full apply output) against its own
+uninterrupted counterpart; total budget < 90 s on the CPU rig.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# platform pins BEFORE any jax import (same discipline as tests/conftest)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+os.environ["DMT_ARTIFACT_CACHE"] = "off"
+
+EXIT_PREEMPTED = 75
+RTOL = 1e-12
+
+_YAML = """\
+basis:
+  number_spins: 12
+  hamming_weight: 6
+hamiltonian:
+  name: heisenberg_chain_12
+  terms:
+    - expression: "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁"
+      sites: [[0,1],[1,2],[2,3],[3,4],[4,5],[5,6],[6,7],[7,8],[8,9],
+              [9,10],[10,11],[11,0]]
+"""
+
+
+def _driver_env(**extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("DMT_FAULT", None)
+    env.update(extra)
+    return env
+
+
+def _run_driver(scratch, tag, fault=None, wait=True, extra_args=()):
+    args = [sys.executable, os.path.join(_REPO, "apps", "diagonalize.py"),
+            os.path.join(scratch, "chain12.yaml"),
+            "-o", os.path.join(scratch, f"{tag}.h5"), "-k", "1",
+            "--tol", "1e-12", "--max-iters", "600", "--devices", "2",
+            "--solver-checkpoint", os.path.join(scratch, f"ck_{tag}.h5"),
+            "--checkpoint-every", "1", "--no-eigenvectors",
+            "--obs-dir", os.path.join(scratch, f"obs_{tag}"),
+            *extra_args]
+    env = _driver_env(**({"DMT_FAULT": fault} if fault else {}))
+    p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    if not wait:
+        return p
+    out, _ = p.communicate(timeout=300)
+    return p.returncode, out
+
+
+def _e0(scratch, tag):
+    import h5py
+
+    with h5py.File(os.path.join(scratch, f"{tag}.h5"), "r") as f:
+        return float(f["hamiltonian/eigenvalues"][0])
+
+
+def _events(scratch, tag):
+    import json
+
+    path = os.path.join(scratch, f"obs_{tag}", "rank_0", "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _assert_close(got, want, what):
+    rel = abs(got - want) / max(abs(want), 1.0)
+    assert rel <= RTOL, (f"{what}: E0 {got!r} vs uninterrupted {want!r} "
+                         f"(rel {rel:.2e} > {RTOL})")
+    print(f"[fault-check] {what}: E0 matches to rel {rel:.2e}")
+
+
+def _kill_leg(scratch, tag, sig, want_rc, e0_ref):
+    """Start a delay-stretched solve, kill it once the first checkpoint
+    generation exists, then relaunch the same argv and check resume +
+    bit-consistency."""
+    ck = os.path.join(scratch, f"ck_{tag}.h5")
+    p = _run_driver(scratch, tag, fault="solver_block:delay=500:n=10000",
+                    wait=False)
+    t0 = time.time()
+    while time.time() - t0 < 120:
+        if any(os.path.exists(ck + suf) for suf in ("", ".structure.h5",
+                                                    ".r0", ".r1")):
+            break
+        if p.poll() is not None:
+            out = p.communicate()[0]
+            raise AssertionError(
+                f"{tag}: solve finished before the kill landed "
+                f"(rc={p.returncode}):\n{out[-2000:]}")
+        time.sleep(0.05)
+    else:
+        p.kill()
+        raise AssertionError(f"{tag}: no checkpoint appeared within 120 s")
+    p.send_signal(sig)
+    out, _ = p.communicate(timeout=120)
+    rc = p.returncode
+    assert rc == want_rc, (f"{tag}: kill rc={rc}, wanted {want_rc}:\n"
+                           f"{out[-2000:]}")
+    if sig == signal.SIGTERM:
+        kinds = [e.get("kind") for e in _events(scratch, tag)]
+        for k in ("solver_checkpoint", "solver_preempted", "run_preempted"):
+            assert k in kinds, f"{tag}: no {k} event in the obs stream"
+    rc2, out2 = _run_driver(scratch, tag)     # SAME argv resumes
+    assert rc2 == 0, f"{tag}: resume failed (rc={rc2}):\n{out2[-2000:]}"
+    assert "resumed from" in out2, \
+        f"{tag}: relaunch did not resume from the checkpoint:\n{out2[-800:]}"
+    _assert_close(_e0(scratch, tag), e0_ref, f"{tag} resume")
+
+
+def main() -> int:
+    t_start = time.time()
+    scratch = tempfile.mkdtemp(prefix="dmt_fault_check_")
+    with open(os.path.join(scratch, "chain12.yaml"), "w") as f:
+        f.write(_YAML)
+
+    # -- uninterrupted reference ------------------------------------------
+    rc, out = _run_driver(scratch, "base")
+    assert rc == 0, f"baseline failed (rc={rc}):\n{out[-2000:]}"
+    e0_ref = _e0(scratch, "base")
+    print(f"[fault-check] baseline E0 = {e0_ref:.12f}")
+
+    # -- 1. preemption: SIGTERM mid-iteration → EXIT_PREEMPTED → resume ---
+    _kill_leg(scratch, "term", signal.SIGTERM, EXIT_PREEMPTED, e0_ref)
+
+    # -- 2. hard kill: SIGKILL → resume from the cadence checkpoint -------
+    _kill_leg(scratch, "kill9", signal.SIGKILL, -signal.SIGKILL, e0_ref)
+
+    # -- 3a. artifact_read: failed basis-checkpoint read retries ----------
+    import shutil
+
+    shutil.copy(os.path.join(scratch, "base.h5"),
+                os.path.join(scratch, "aread.h5"))
+    rc, out = _run_driver(scratch, "aread", fault="artifact_read:n=1")
+    assert rc == 0, f"artifact_read leg failed (rc={rc}):\n{out[-2000:]}"
+    assert "[fault-injection]" in out, \
+        f"artifact_read fault never fired on the restore path:\n{out[-800:]}"
+    assert "restored from" in out, \
+        f"artifact_read leg never restored the basis:\n{out[-800:]}"
+    _assert_close(_e0(scratch, "aread"), e0_ref, "artifact_read retry")
+
+    # -- 3b. ckpt_write / ckpt_rename: failed saves degrade softly --------
+    for tag, fault in (("ckw", "ckpt_write:n=1"),
+                       ("ckr", "ckpt_rename:n=1")):
+        rc, out = _run_driver(scratch, tag, fault=fault)
+        assert rc == 0, f"{fault} leg failed (rc={rc}):\n{out[-2000:]}"
+        kinds = [(e.get("kind"), e.get("status"))
+                 for e in _events(scratch, tag)]
+        assert ("solver_checkpoint", "failed") in kinds, \
+            f"{fault}: no solver_checkpoint{{status=failed}} event"
+        assert ("solver_checkpoint", "written") in kinds, \
+            f"{fault}: later checkpoint generations never succeeded"
+        _assert_close(_e0(scratch, tag), e0_ref, f"{fault} degrade")
+
+    # -- in-process legs: exchange, plan_upload, plan_chunk_read ----------
+    import numpy as np
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.utils import faults
+    from distributed_matvec_tpu.utils.config import update_config
+
+    cfg = load_config_from_yaml(os.path.join(scratch, "chain12.yaml"))
+    cfg.basis.build()
+    n = cfg.basis.number_states
+    x = np.random.default_rng(11).standard_normal(n)
+    x /= np.linalg.norm(x)
+
+    eng = DistributedEngine(cfg.hamiltonian, n_devices=2, mode="ell")
+    xh = eng.to_hashed(x)
+    y_ref = np.asarray(eng.matvec(xh))
+
+    # exchange: injected collective failure aborts cleanly, next apply is
+    # bit-identical (the supervisor-relaunch story in one process)
+    os.environ["DMT_FAULT"] = "exchange:n=1"
+    faults.reset()
+    try:
+        eng.matvec(xh)
+        raise AssertionError("exchange fault never fired")
+    except RuntimeError as e:
+        assert "[fault-injection]" in str(e), e
+    y2 = np.asarray(eng.matvec(xh))
+    assert np.array_equal(y2, y_ref), "post-exchange-fault apply differs"
+    print("[fault-check] exchange: clean abort, next apply bit-identical")
+
+    # plan_upload: transient H2D stage failure heals by retry (streamed is
+    # bit-identical to FUSED, so the no-fault streamed apply is the
+    # reference; ell agrees to roundoff)
+    eng_s = DistributedEngine(cfg.hamiltonian, n_devices=2, mode="streamed")
+    xs = eng_s.to_hashed(x)
+    ys_ref = np.asarray(eng_s.matvec(xs))
+    assert np.allclose(ys_ref, y_ref, atol=1e-12), "streamed vs ell"
+    os.environ["DMT_FAULT"] = "plan_upload:n=1"
+    faults.reset()
+    ys = np.asarray(eng_s.matvec(xs))
+    assert np.array_equal(ys, ys_ref), "streamed apply after upload retry"
+    assert faults.fired_count("plan_upload") == 1
+    print("[fault-check] plan_upload: retried and bit-identical")
+
+    # plan_chunk_read: disk-tier read faults heal by retry; a checksum-
+    # corrupt chunk rebuilds from structure
+    os.environ.pop("DMT_FAULT")
+    os.environ["DMT_ARTIFACT_CACHE"] = "on"
+    os.environ["DMT_ARTIFACT_DIR"] = os.path.join(scratch, "artifacts")
+    update_config(stream_plan_ram_gb=0.0)
+    faults.reset()
+    eng_d = DistributedEngine(cfg.hamiltonian, n_devices=2, mode="streamed")
+    assert eng_d._plan_chunks is None, "disk tier not active"
+    os.environ["DMT_FAULT"] = "plan_chunk_read:n=1"
+    faults.reset()
+    yd = np.asarray(eng_d.matvec(eng_d.to_hashed(x)))
+    assert np.array_equal(yd, ys_ref), "disk-tier apply after read retry"
+    os.environ.pop("DMT_FAULT")
+    faults.reset()
+    import gc
+
+    import h5py
+
+    path = list(eng_d._plan_disk.values())[0]
+    for fobj in list(eng_d._plan_files.values()):
+        fobj.close()
+    eng_d._plan_files.clear()
+    with h5py.File(path, "r+") as f:
+        f["engine_structure"]["dest_0_0"][...] = 0      # torn chunk
+    yc = np.asarray(eng_d.matvec(eng_d.to_hashed(x)))
+    assert np.array_equal(yc, ys_ref), \
+        "corrupt-chunk rebuild is not bit-identical"
+    counters = obs.snapshot()["counters"]
+    assert counters.get(
+        "artifact_cache{event=corrupt,kind=stream_plan}", 0) >= 1, \
+        "corrupt sidecar chunk never recorded artifact_cache{event=corrupt}"
+    print("[fault-check] plan_chunk_read: retry heals; corrupt chunk "
+          "rebuilt from structure bit-identically")
+    del eng_d
+    gc.collect()
+
+    print(f"[fault-check] PASS ({time.time() - t_start:.1f} s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
