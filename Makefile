@@ -12,7 +12,8 @@ OBS_THRESHOLD ?= 0.2
 HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
-	obs-check health-check mem-check stream-check fault-check clean
+	obs-check health-check mem-check stream-check fault-check \
+	roofline-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -20,6 +21,7 @@ check:
 	$(MAKE) health-check
 	$(MAKE) mem-check
 	$(MAKE) stream-check
+	$(MAKE) roofline-check
 	$(MAKE) fault-check
 
 check-fast:
@@ -87,6 +89,16 @@ mem-check:
 # disk writes, and the plan sidecar save/restore round-trip.
 stream-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/stream_check.py
+
+# Phase-attribution gate (tools/roofline_check.py): apply HLO
+# byte-identity with phase probes on vs off (local ell + distributed
+# fused), `obs_report roofline` model-vs-measured reconciliation on a
+# live streamed run (phase walls sum to the measured apply wall within
+# 10%, binding resource named, pipelined-apply estimate finite), and the
+# bench_trend gate passing on an appended record AND firing on a
+# synthetic 10x regression.  Deterministic, ~30 s on the CPU rig.
+roofline-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/roofline_check.py
 
 # Chaos gate (tools/fault_check.py): the ROADMAP's resumed-run
 # bit-consistency acceptance as a repeatable gate — kill a 2-device solve

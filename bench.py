@@ -232,6 +232,23 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
         if wm:
             out["peak_hbm_bytes"] = int(wm["peak_bytes"])
 
+    # phase-attribution columns (`obs_report diff --phases` and the trend
+    # gate read these): the timing loops above call the raw jitted program,
+    # so run ONE instrumented apply to emit the apply_phases event whose
+    # structural per-phase counts become phase_<name>_<field> metrics
+    if obs.phases_enabled():
+        # two applies: the first bears the health-probe compile, the
+        # second's wall is the steady instrumented-dispatch number
+        eng.matvec(xj)
+        eng.matvec(xj)
+        pev = obs.events("apply_phases")
+        if pev:
+            out["apply_wall_ms"] = pev[-1]["wall_ms"]
+            for p, rec in pev[-1]["phases"].items():
+                for fld in ("bytes", "gathers"):
+                    if rec.get(fld):
+                        out[f"phase_{p}_{fld}"] = int(rec[fld])
+
     if solver_iters:
         from distributed_matvec_tpu.solve.lanczos import lanczos
 
@@ -317,6 +334,18 @@ def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1):
             napp = max(stall.count - stall_n0, 1)
             out["plan_stream_stall_ms"] = round(
                 (stall.sum - stall_sum0) / napp, 4)
+            # per-phase columns from the last streamed apply (already
+            # instrumented — eng.matvec emitted apply_phases above)
+            pev = [e for e in obs.events("apply_phases")
+                   if e.get("engine") == "distributed"
+                   and e.get("mode") == "streamed"]
+            if pev:
+                for p, rec in pev[-1]["phases"].items():
+                    for fld in ("bytes", "gathers"):
+                        if rec.get(fld):
+                            out[f"phase_{p}_{fld}"] = int(rec[fld])
+                    if rec.get("wall_ms") is not None:
+                        out[f"phase_{p}_ms"] = rec["wall_ms"]
         _progress(f"{name}: {mode} steady {steady_ms:.2f} ms/apply")
     out["stream_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
@@ -384,6 +413,10 @@ def main():
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="profile exactly one apply per config into "
                          "DIR/<config> via jax.profiler")
+    ap.add_argument("--trend-out", default=None, metavar="PATH",
+                    help="where to append the compact bench-trend record "
+                         "(default: PROGRESS.jsonl next to this script; "
+                         "'none' disables — see tools/bench_trend.py)")
     args = ap.parse_args()
     global _PROFILE_DIR
     _PROFILE_DIR = args.profile_dir
@@ -402,6 +435,8 @@ def main():
             argv += ["--detail-out", args.detail_out]
         if args.profile_dir:
             argv += ["--profile-dir", args.profile_dir]
+        if args.trend_out:
+            argv += ["--trend-out", args.trend_out]
         os.execve(sys.executable, argv, env)
 
     if args.smoke or args.cpu_fallback:
@@ -543,6 +578,28 @@ def main():
         line["note"] = ("accelerator unreachable at bench time; CPU numbers "
                         "in BENCH_DETAIL.json (chain_32_symm omitted — "
                         "CPU-infeasible); recorded TPU results in README")
+    # cross-PR trend ledger: one compact record per bench run appended to
+    # PROGRESS.jsonl (tools/bench_trend.py renders and gates the
+    # trajectory) — soft-fail, a read-only checkout costs nothing
+    if (args.trend_out or "").lower() != "none":
+        try:
+            import jax
+
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import bench_trend
+
+            mode = ("smoke" if args.smoke
+                    else "cpu_fallback" if args.cpu_fallback else "full")
+            rec = bench_trend.compact_record(
+                {"main": main_cfg, **detail}, mode=mode,
+                backend=jax.default_backend())
+            trend_path = args.trend_out or bench_trend.default_progress_path()
+            if rec["configs"] and bench_trend.append_record(trend_path, rec):
+                line["trend_file"] = os.path.basename(trend_path)
+        except Exception as e:      # the ledger must never cost the run
+            _progress(f"trend append skipped: {e!r}")
+
     # registry totals (cache hit/miss, AOT reuse, transfer bytes, retraces)
     # as the run's closing event, then flush so `obs_report summarize`
     # reads a complete stream the moment this process exits

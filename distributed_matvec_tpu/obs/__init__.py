@@ -23,10 +23,16 @@ visibility GSPMD (arXiv:2105.04663) treats as a first-class signal):
   norm reductions on engine applies, exchange overflow/invalid counters)
   and the solver watchdog (``solver_health`` events; ``DMT_HEALTH=strict``
   raises :class:`~.health.HealthError` on critical conditions).
+* :mod:`~.phases` / :mod:`~.roofline` — per-apply phase attribution
+  (``apply_phases`` events: plan H2D / compute / exchange / accumulate
+  with exact structural byte/gather/flop counts, apply HLO byte-identical
+  on or off) and the analytical roofline model over them (calibrated
+  rates, binding-resource naming, pipelined-apply speedup estimates) —
+  DESIGN.md §22.
 * ``tools/obs_report.py`` — the reader: ``summarize`` one run, ``merge`` /
   ``report --ranks`` a multi-rank one (skew-corrected timeline, per-rank
-  straggler attribution), ``diff`` two runs as a CI perf gate, ``tail`` a
-  live one.
+  straggler attribution), ``diff`` two runs as a CI perf gate,
+  ``roofline`` the phase/cost-model report, ``tail`` a live one.
 
 Config: ``DMT_OBS_DIR`` (or ``obs_dir``) points the sink at a run
 directory; unset ⇒ in-memory only; ``DMT_OBS=off`` disables the layer
@@ -48,6 +54,7 @@ from .memory import (MemoryReport, OomError, attach_oom,
 from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
                       reset_metrics, series_name)
 from .metrics import snapshot as _metrics_snapshot
+from .phases import (PHASES, emit_apply_phases, phases_enabled, zero_counts)
 
 __all__ = [
     "annotate",
@@ -89,6 +96,10 @@ __all__ = [
     "track",
     "track_tree",
     "watermark_due",
+    "PHASES",
+    "emit_apply_phases",
+    "phases_enabled",
+    "zero_counts",
 ]
 
 

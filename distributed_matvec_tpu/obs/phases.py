@@ -1,0 +1,135 @@
+"""Per-apply phase attribution: where one matvec spends its time and bytes.
+
+Fourth pillar of the telemetry subsystem (see ``obs/__init__``).  Whole-apply
+wall clocks (``matvec_apply`` events, PR 3) say *that* an apply was slow;
+the ROADMAP's next levers — plan compression ("attacks the roofline itself")
+and pipelined applies (overlap exchange with chunk compute) — are bets about
+*where inside one apply* the time goes.  This module decomposes every eager
+apply into named phases and emits one ``apply_phases`` event per apply:
+
+==============  ============================================================
+phase           meaning
+==============  ============================================================
+``plan_h2d``    host→device plan streaming (streamed mode's per-apply chunk
+                uploads; zero for resident-structure modes)
+``compute``     gather + multiply: structure-table / exchange-slot gathers,
+                the fused orbit scan, coefficient multiply-accumulate
+``exchange``    the cross-shard amplitude ``all_to_all`` payload
+``accumulate``  receive-side ``segment_sum`` / tail scatter-adds
+``overhead``    dispatch + validation + everything unattributed (defined as
+                whole-apply wall minus the attributed phases at report time)
+==============  ============================================================
+
+Contract (the health-probe pattern, DESIGN.md §18 applied to timing): the
+apply HLO is **byte-identical** with phase attribution on or off.  Nothing
+here adds device work — ``bytes`` / ``gathers`` / ``flops`` are *structural*
+counts the engines already know host-side (pure functions of the engine
+geometry, computed once per (mode, columns) and cached), and wall times are
+host ``perf_counter`` readings around dispatch segments the engines already
+take (the streamed chunk-stream loop measures its H2D waits anyway).  Phase
+*wall* attribution for single-program applies happens at report time
+(``obs/roofline.py`` splits the measured wall across phases in proportion to
+the cost model), so the recording path stays sync-free.
+
+Exactness invariant (pinned by ``tests/test_phases.py``): the per-phase
+``bytes``/``gathers``/``flops`` sum to the event's ``*_total`` fields
+exactly, and cross-check against independent engine quantities
+(``plan_bytes``, ``_exchange_nbytes``, the ``bytes_h2d`` counter).
+
+``DMT_PHASES=off`` (or ``config.phases``) disables the events while leaving
+every apply program untouched — the byte-identity guard in
+``tools/roofline_check.py`` compiles the apply both ways and compares HLO.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ..utils.config import get_config
+from .events import emit, obs_enabled
+
+__all__ = [
+    "PHASES",
+    "PHASE_RESOURCE",
+    "ORBIT_OPS",
+    "phases_enabled",
+    "zero_counts",
+    "emit_apply_phases",
+]
+
+#: Flops charged per group element of the fused orbit scan (coset-walk
+#: step: permute + phase + compare).  A documented constant of the cost
+#: model (DESIGN.md §22), not a hardware truth — both engines' fused-mode
+#: compute counts use it.
+ORBIT_OPS = 16
+
+#: Canonical phase order (reports render in this order; ``overhead`` is
+#: derived at report time and carries no structural counts).
+PHASES = ("plan_h2d", "compute", "exchange", "accumulate", "overhead")
+
+#: The hardware resource each phase is bound by — what a roofline report
+#: names when a phase dominates.
+PHASE_RESOURCE = {
+    "plan_h2d": "h2d bandwidth",
+    "compute": "gather rate",
+    "exchange": "interconnect bandwidth",
+    "accumulate": "scatter rate",
+    "overhead": "host dispatch",
+}
+
+
+def phases_enabled() -> bool:
+    """Whether ``apply_phases`` events are emitted (requires obs on; the
+    env var is consulted directly so harnesses can flip it per subprocess —
+    same contract as :func:`~.events.obs_enabled`)."""
+    if not obs_enabled():
+        return False
+    env = os.environ.get("DMT_PHASES")
+    knob = env if env is not None else get_config().phases
+    return str(knob).strip().lower() not in ("off", "0", "false", "no")
+
+
+def zero_counts() -> Dict[str, Dict[str, int]]:
+    """A fresh all-zero per-phase count dict (``overhead`` excluded — it
+    carries no structural counts by definition)."""
+    return {p: {"bytes": 0, "gathers": 0, "flops": 0}
+            for p in PHASES if p != "overhead"}
+
+
+def emit_apply_phases(engine: str, mode: str, apply_index: int,
+                      wall_ms: float, counts: Dict[str, Dict[str, int]],
+                      chunks: int = 1, columns: int = 1,
+                      measured_ms: Optional[Dict[str, float]] = None,
+                      chunk_timeline: Optional[list] = None
+                      ) -> Optional[dict]:
+    """Record one apply's phase decomposition.
+
+    ``counts`` maps phase → ``{bytes, gathers, flops}`` (structural, exact);
+    ``measured_ms`` carries phases whose wall time was *measured* host-side
+    (streamed mode's ``plan_h2d`` H2D waits) rather than model-attributed;
+    ``chunk_timeline`` is the streamed per-chunk record
+    ``[{chunk, stall_ms, dispatch_ms}, ...]`` the pipelined-apply estimate
+    reads.  Totals are computed here so readers (and the exactness tests)
+    never re-derive them."""
+    if not phases_enabled():
+        return None
+    totals = {"bytes": 0, "gathers": 0, "flops": 0}
+    phases = {}
+    for p, c in counts.items():
+        rec = {k: int(c.get(k, 0)) for k in ("bytes", "gathers", "flops")}
+        if measured_ms and p in measured_ms:
+            rec["wall_ms"] = round(float(measured_ms[p]), 4)
+        for k in totals:
+            totals[k] += rec[k]
+        phases[p] = rec
+    ev = {"engine": str(engine), "mode": str(mode),
+          "apply": int(apply_index), "wall_ms": round(float(wall_ms), 4),
+          "chunks": int(chunks), "columns": int(columns),
+          "phases": phases,
+          "bytes_total": totals["bytes"],
+          "gathers_total": totals["gathers"],
+          "flops_total": totals["flops"]}
+    if chunk_timeline:
+        ev["chunk_timeline"] = chunk_timeline
+    return emit("apply_phases", **ev)

@@ -1,0 +1,384 @@
+"""Analytical roofline model over ``apply_phases`` events + rate calibration.
+
+DESIGN.md §2 established that the gather roofline governs the apply; this
+module turns that one-off measurement into a per-run report: for every
+(engine, mode) seen in a telemetry run it combines the *structural* phase
+counts (``obs/phases.py``) with measured hardware rates to compute
+
+* a **bound time** per phase (the time the phase would take running at the
+  hardware rate: bytes / bandwidth, gathers / gather-rate),
+* an **attributed wall** per phase — measured where the engines measured it
+  (streamed ``plan_h2d`` H2D waits), otherwise the leftover apply wall split
+  in proportion to the bounds, so the phase walls *sum to the measured apply
+  wall exactly*,
+* the per-phase **achieved-vs-bound fraction** (bound / attributed wall:
+  1.0 = running at the roofline),
+* the **binding resource** — the phase with the largest bound share, named
+  via :data:`~.phases.PHASE_RESOURCE` (chain_32_symm's answer is "gather
+  rate" at ≈93%, DESIGN.md §2; a streamed run's is typically "h2d
+  bandwidth" or "gather rate" depending on plan size), and
+* a **pipelined-apply speedup estimate** — the ROADMAP's overlap item priced
+  before it's built: overlapping the exchange of chunk *i* with the compute
+  of chunk *i+1* saves ``min(compute, exchange) · (1 − 1/nchunks)``, so
+
+      speedup = wall / (wall − min(compute_wall, exchange_wall)·(1 − 1/nchunks))
+
+  (1.0 for single-shard/local engines — nothing to overlap).
+
+Calibration: measured rates live in a content-addressed JSON sidecar under
+the artifact root (``calibration/<fp>.json``; fingerprint = backend +
+device kind).  ``tools/gather_bound.py`` writes it (the microbenchmark that
+used to print-and-discard); this module and ``tools/capacity.py`` read it.
+Without a sidecar the documented DESIGN.md §2 defaults apply (TPU v5e) or
+conservative CPU-rig defaults — every report states its calibration source.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..utils.logging import log_debug, log_warn
+from .phases import PHASE_RESOURCE, PHASES
+
+__all__ = [
+    "DEFAULT_CALIBRATIONS",
+    "default_calibration",
+    "calibration_path",
+    "save_calibration",
+    "load_calibration",
+    "resolve_calibration",
+    "phase_bounds_ms",
+    "attribute_phases",
+    "roofline_report",
+    "print_roofline",
+    "reconcile_error",
+]
+
+#: Rate fields every calibration carries (units in the name).
+RATE_FIELDS = ("gather_rows_per_s", "h2d_bytes_per_s",
+               "exchange_bytes_per_s", "flops_per_s")
+
+#: Documented defaults per backend family.  TPU numbers are the DESIGN.md §2
+#: v5e measurements (gather 160–185 M rows/s at large tables — the flat,
+#: locality-independent per-row rate); h2d/ICI are nominal catalog numbers.
+#: CPU numbers are conservative single-core-rig figures for the virtual-
+#: device test mesh; a `tools/gather_bound.py` run replaces them with
+#: measured rates.
+DEFAULT_CALIBRATIONS: Dict[str, Dict[str, float]] = {
+    "tpu": {"gather_rows_per_s": 185e6, "h2d_bytes_per_s": 8e9,
+            "exchange_bytes_per_s": 45e9, "flops_per_s": 2e11},
+    "cpu": {"gather_rows_per_s": 25e6, "h2d_bytes_per_s": 8e9,
+            "exchange_bytes_per_s": 4e9, "flops_per_s": 5e9},
+}
+
+#: Scatter-side entries are weighted 2× a gather (the ELL split cost model's
+#: measured weighting, parallel/engine.py::choose_ell_split).
+SCATTER_WEIGHT = 2.0
+
+
+def default_calibration(backend: Optional[str] = None) -> dict:
+    """The analytic default rates for ``backend`` (``jax.default_backend()``
+    when None), tagged ``source="default"`` so reports say so."""
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    base = DEFAULT_CALIBRATIONS.get(
+        str(backend).lower(), DEFAULT_CALIBRATIONS["cpu"])
+    return dict(base, backend=str(backend), source="default")
+
+
+def _calibration_fingerprint(backend: str, device_kind: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        f"calibration|{backend}|{device_kind}|v1".encode()).hexdigest()
+
+
+def calibration_path(backend: Optional[str] = None,
+                     device_kind: Optional[str] = None) -> Optional[str]:
+    """Content-addressed sidecar path for this backend's measured rates
+    (None when the artifact layer is off)."""
+    from ..utils.artifacts import artifact_path, artifacts_enabled
+
+    if not artifacts_enabled():
+        return None
+    if backend is None or device_kind is None:
+        try:
+            import jax
+            backend = backend or jax.default_backend()
+            device_kind = device_kind or jax.devices()[0].device_kind
+        except Exception:
+            return None
+    return artifact_path(
+        "calibration", _calibration_fingerprint(backend, device_kind),
+        ".json")
+
+
+def save_calibration(cal: dict, path: Optional[str] = None) -> Optional[str]:
+    """Persist measured rates (atomic write; soft-fail — a read-only
+    checkout must not turn a microbenchmark into an I/O error).  Returns
+    the path written, or None."""
+    path = path or calibration_path(cal.get("backend"),
+                                    cal.get("device_kind"))
+    if not path:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "w") as f:
+            json.dump(dict(cal, source="measured"), f, indent=1,
+                      sort_keys=True)
+        os.replace(path + ".tmp", path)
+    except OSError as e:
+        log_warn(f"calibration save failed ({path}): {e!r}")
+        return None
+    log_debug(f"calibration saved to {path}")
+    return path
+
+
+def load_calibration(path: Optional[str] = None) -> Optional[dict]:
+    """Read a calibration sidecar (the default content-addressed one when
+    ``path`` is None); None when absent/unreadable."""
+    path = path or calibration_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            cal = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        log_warn(f"calibration sidecar unreadable ({path}): {e!r}")
+        return None
+    if not all(k in cal for k in RATE_FIELDS):
+        log_warn(f"calibration sidecar {path} missing rate fields; ignored")
+        return None
+    return cal
+
+
+def resolve_calibration(path: Optional[str] = None,
+                        backend: Optional[str] = None) -> dict:
+    """Explicit path > saved measured sidecar > documented defaults.  An
+    explicit path that is missing or invalid raises — a user who pointed
+    at a calibration must never get a silently re-priced report."""
+    if path:
+        cal = load_calibration(path)
+        if cal is None:
+            raise FileNotFoundError(
+                f"calibration file {path} is missing or carries no rate "
+                "fields (expected a tools/gather_bound.py JSON)")
+        return cal
+    cal = load_calibration()
+    return cal if cal is not None else default_calibration(backend)
+
+
+# ---------------------------------------------------------------------------
+# the model
+
+
+def phase_bounds_ms(phases: Dict[str, dict], cal: dict) -> Dict[str, float]:
+    """Bound time (ms) per phase at the calibrated rates:
+
+    * ``plan_h2d``   bytes / h2d_bytes_per_s
+    * ``compute``    gathers / gather_rows_per_s + flops / flops_per_s
+    * ``exchange``   bytes / exchange_bytes_per_s
+    * ``accumulate`` SCATTER_WEIGHT · gathers / gather_rows_per_s
+
+    Phases with no structural counts bound at 0 (``overhead`` always)."""
+    g = float(cal["gather_rows_per_s"])
+    h = float(cal["h2d_bytes_per_s"])
+    x = float(cal["exchange_bytes_per_s"])
+    fl = float(cal["flops_per_s"])
+    out = {}
+    for p, c in phases.items():
+        by = float(c.get("bytes", 0))
+        ga = float(c.get("gathers", 0))
+        f = float(c.get("flops", 0))
+        if p == "plan_h2d":
+            t = by / h
+        elif p == "compute":
+            t = ga / g + f / fl
+        elif p == "exchange":
+            t = by / x
+        elif p == "accumulate":
+            t = SCATTER_WEIGHT * ga / g
+        else:
+            t = 0.0
+        out[p] = t * 1e3
+    return out
+
+
+def attribute_phases(phases: Dict[str, dict], wall_ms: float,
+                     cal: dict) -> Dict[str, dict]:
+    """Split one apply's measured wall across phases.
+
+    Measured phase walls (streamed ``plan_h2d``'s H2D waits) are taken as
+    recorded; the remaining wall is distributed over the model-bounded
+    phases in proportion to their bounds (so a phase's achieved-vs-bound
+    fraction is bound/attributed — the same number for every attributed
+    phase, which is the honest statement a host-side-only decomposition can
+    make); with no bounded phases the remainder lands in ``overhead``.  The
+    attributed walls sum to ``wall_ms`` exactly by construction."""
+    bounds = phase_bounds_ms(phases, cal)
+    measured = {p: float(c["wall_ms"]) for p, c in phases.items()
+                if c.get("wall_ms") is not None}
+    remaining = max(wall_ms - sum(measured.values()), 0.0)
+    bounded = {p: b for p, b in bounds.items()
+               if b > 0 and p not in measured}
+    total_bound = sum(bounded.values())
+    out = {}
+    for p in PHASES:
+        if p != "overhead" and p not in phases:
+            continue
+        c = phases.get(p, {})
+        if p in measured:
+            w = measured[p]
+        elif p in bounded and total_bound > 0:
+            w = remaining * bounded[p] / total_bound
+        elif p == "overhead":
+            w = remaining if total_bound <= 0 else 0.0
+        else:
+            w = 0.0
+        b = bounds.get(p, 0.0)
+        out[p] = {"wall_ms": w, "bound_ms": b,
+                  "achieved_fraction": (b / w) if w > 0 else None,
+                  "bytes": int(c.get("bytes", 0)),
+                  "gathers": int(c.get("gathers", 0)),
+                  "flops": int(c.get("flops", 0)),
+                  "measured": p in measured}
+    return out
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def roofline_report(events: List[dict],
+                    calibration: Optional[dict] = None) -> dict:
+    """The full roofline report for one run: per (engine, mode) group the
+    mean steady apply (the first apply per group is dropped as the
+    compile/warm-up one whenever ≥2 were recorded), phase attribution,
+    binding resource, and the pipelined-apply speedup estimate."""
+    cal = calibration or resolve_calibration()
+    groups: Dict[tuple, List[dict]] = {}
+    for ev in events:
+        if ev.get("kind") == "apply_phases" and ev.get("phases"):
+            groups.setdefault(
+                (str(ev.get("engine")), str(ev.get("mode"))), []).append(ev)
+    out = {"calibration": {k: cal.get(k) for k in
+                           RATE_FIELDS + ("backend", "device_kind",
+                                          "source")},
+           "groups": {}}
+    for (engine, mode), evs in sorted(groups.items()):
+        steady = evs[1:] if len(evs) > 1 else evs
+        wall = _mean([float(e.get("wall_ms") or 0.0) for e in steady])
+        nchunks = max(int(steady[-1].get("chunks") or 1), 1)
+        # mean structural counts + mean measured phase walls over the
+        # steady applies (counts are constant per (mode, columns); the
+        # mean keeps mixed-column runs honest)
+        phase_names = sorted({p for e in steady for p in e["phases"]})
+        agg: Dict[str, dict] = {}
+        for p in phase_names:
+            recs = [e["phases"].get(p) or {} for e in steady]
+            walls = [float(r["wall_ms"]) for r in recs
+                     if r.get("wall_ms") is not None]
+            agg[p] = {"bytes": int(_mean([r.get("bytes", 0) for r in recs])),
+                      "gathers": int(_mean([r.get("gathers", 0)
+                                            for r in recs])),
+                      "flops": int(_mean([r.get("flops", 0) for r in recs])),
+                      "wall_ms": _mean(walls) if walls else None}
+        attributed = attribute_phases(agg, wall, cal)
+        bound_total = sum(a["bound_ms"] for a in attributed.values())
+        binding = max(attributed,
+                      key=lambda p: attributed[p]["bound_ms"]) \
+            if bound_total > 0 else "overhead"
+        comp = attributed.get("compute", {}).get("wall_ms", 0.0)
+        exch = attributed.get("exchange", {}).get("wall_ms", 0.0)
+        overlap = min(comp, exch) * (1.0 - 1.0 / nchunks) \
+            if nchunks > 1 else 0.0
+        pipelined = max(wall - overlap, 1e-9)
+        stalls = [c.get("stall_ms") for e in steady
+                  for c in (e.get("chunk_timeline") or [])
+                  if c.get("stall_ms") is not None]
+        grp = {
+            "applies": len(evs),
+            "steady_applies": len(steady),
+            "wall_ms": round(wall, 4),
+            "chunks": nchunks,
+            "phases": {p: {k: (round(v, 4) if isinstance(v, float) else v)
+                           for k, v in a.items()}
+                       for p, a in attributed.items()},
+            "binding_phase": binding,
+            "binding_resource": PHASE_RESOURCE.get(binding, binding),
+            "roofline_fraction": round(bound_total / wall, 4)
+            if wall > 0 else None,
+            "pipelined_speedup_estimate": round(wall / pipelined, 3),
+            "pipelined_overlap_ms": round(overlap, 4),
+        }
+        if stalls:
+            grp["mean_chunk_stall_ms"] = round(_mean(stalls), 4)
+        out["groups"][f"{engine}/{mode}"] = grp
+    return out
+
+
+def reconcile_error(report: dict) -> float:
+    """Max relative |Σ phase walls − measured wall| / wall over the
+    report's groups — the reconciliation the roofline-check gate asserts
+    stays within tolerance (≈0 by construction; a drift means the
+    attribution broke)."""
+    worst = 0.0
+    for grp in report.get("groups", {}).values():
+        wall = float(grp.get("wall_ms") or 0.0)
+        if wall <= 0:
+            continue
+        s = sum(float(a.get("wall_ms") or 0.0)
+                for a in grp.get("phases", {}).values())
+        worst = max(worst, abs(s - wall) / wall)
+    return worst
+
+
+def print_roofline(report: dict) -> None:
+    cal = report.get("calibration", {})
+    print(f"calibration: {cal.get('source')} "
+          f"(backend={cal.get('backend')}"
+          + (f", {cal.get('device_kind')}" if cal.get("device_kind")
+             else "") + ")")
+    print("  " + "  ".join(f"{k}={cal.get(k):.3g}" for k in RATE_FIELDS
+                           if cal.get(k)))
+    for name, grp in sorted(report.get("groups", {}).items()):
+        print(f"\n{name}: {grp['steady_applies']} steady applies, "
+              f"wall {grp['wall_ms']:.3f} ms/apply, "
+              f"{grp['chunks']} chunk(s)")
+        print(f"  {'phase':<12} {'wall ms':>10} {'bound ms':>10} "
+              f"{'achieved':>9} {'bytes':>14} {'gathers':>12}")
+        for p in PHASES:
+            a = grp["phases"].get(p)
+            if a is None:
+                continue
+            ach = a.get("achieved_fraction")
+            if ach is None:
+                cell = "-"
+            elif a.get("measured") and ach > 1.0:
+                # a measured wall BELOW the un-overlapped bound: the phase
+                # is hidden behind other work (the double-buffered plan
+                # stream doing its job) — a fraction > 1 would misread
+                cell = "hidden"
+            else:
+                cell = f"{ach:.1%}"
+            print(f"  {p:<12} {a['wall_ms']:>10.4f} {a['bound_ms']:>10.4f} "
+                  f"{cell:>9} "
+                  f"{a['bytes']:>14,} {a['gathers']:>12,}"
+                  + ("  (measured)" if a.get("measured") else ""))
+        frac = grp.get("roofline_fraction")
+        print(f"  binding resource: {grp['binding_resource']} "
+              f"(phase {grp['binding_phase']}"
+              + (f", run at {frac:.1%} of the combined roofline)"
+                 if frac is not None else ")"))
+        if grp.get("mean_chunk_stall_ms") is not None:
+            print(f"  mean plan-stream chunk stall: "
+                  f"{grp['mean_chunk_stall_ms']:.4f} ms")
+        print(f"  pipelined-apply estimate: overlap exchange with chunk "
+              f"compute saves {grp['pipelined_overlap_ms']:.3f} ms "
+              f"-> {grp['pipelined_speedup_estimate']:.2f}x")

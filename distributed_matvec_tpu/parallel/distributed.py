@@ -77,6 +77,7 @@ from ..models.operator import Operator
 from ..obs import annotate, counter, emit, histogram, obs_enabled
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
+from ..obs import phases as obs_phases
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, hash64, state_index_bucketed
 from ..ops.split_gather import prep_gather, split_gather_enabled
@@ -318,6 +319,9 @@ class DistributedEngine:
         self._warned_traced_check = False
         self._deferred_failure: Optional[str] = None
         self._apply_idx = 0
+        #: streamed mode's per-apply chunk timeline (stall + dispatch ms),
+        #: drained by _matvec_impl into the apply_phases event
+        self._stream_timeline: list = []
         self._plan_remote_unique: Optional[int] = None
         self._n_my_shards = sum(
             1 for d in range(D) if self._shard_addressable(d))
@@ -1893,8 +1897,14 @@ class DistributedEngine:
             xp = pad_prog(x)
             y = zeros_prog()
             record_stall = obs_enabled()
+            # per-chunk timeline for phase attribution: the measured H2D
+            # wait (the stall above) plus the host dispatch wall of each
+            # chunk program — host perf_counter readings only, no syncs
+            # beyond the stall measurement obs already takes
+            timeline = [] if obs_phases.phases_enabled() else None
             pending = self._upload_plan_chunk(0) if nchunks else None
             for ci in range(nchunks):
+                entry = {"chunk": ci}
                 if record_stall:
                     # the wait below is the stream's whole performance
                     # story: ~0 when the upload finished while the device
@@ -1904,11 +1914,19 @@ class DistributedEngine:
                     # the host sync entirely
                     _t0 = time.perf_counter()
                     jax.block_until_ready(pending)
-                    histogram("plan_stream_stall_ms").observe(
-                        (time.perf_counter() - _t0) * 1e3)
+                    stall_ms = (time.perf_counter() - _t0) * 1e3
+                    histogram("plan_stream_stall_ms").observe(stall_ms)
+                    entry["stall_ms"] = round(stall_ms, 4)
+                _td = time.perf_counter()
                 y = chunk_prog(xp, y, jnp.int32(ci * B), *pending)
+                if timeline is not None:
+                    entry["dispatch_ms"] = round(
+                        (time.perf_counter() - _td) * 1e3, 4)
+                    timeline.append(entry)
                 if ci + 1 < nchunks:
                     pending = self._upload_plan_chunk(ci + 1)
+            if timeline is not None:
+                self._stream_timeline.extend(timeline)
             return epi_prog(y, x, self._diag)
 
         def run(x):
@@ -2488,20 +2506,118 @@ class DistributedEngine:
             counter("exchange_bytes", engine="distributed").inc(nbytes)
             emit("matvec_apply", engine="distributed", apply=idx,
                  wall_ms=round(dt_ms, 4), bytes=nbytes)
+            if obs_phases.phases_enabled():
+                tail_elems = 1
+                for s in xh.shape[2:]:
+                    tail_elems *= int(s)
+                k = tail_elems // 2 if self.pair else tail_elems
+                timeline = measured = None
+                if self.mode == "streamed":
+                    timeline = self._stream_timeline or None
+                    self._stream_timeline = []
+                    if timeline:
+                        measured = {"plan_h2d": sum(
+                            c.get("stall_ms", 0.0) for c in timeline)}
+                obs_phases.emit_apply_phases(
+                    "distributed", self.mode, idx, dt_ms,
+                    self._phase_counts(tail_elems), chunks=self._nchunks(),
+                    columns=max(k, 1), measured_ms=measured,
+                    chunk_timeline=timeline)
         histogram("matvec_apply_ms", engine="distributed").observe(dt_ms)
         return y
+
+    def _nchunks(self) -> int:
+        """Row chunks one apply streams through (1 for the single-program
+        ell/compact plans)."""
+        if self.mode == "streamed":
+            return int(self._plan_nchunks_v)
+        if self.mode == "fused":
+            B = self._last_program_key or self.batch_size
+            return -(-self.shard_size // max(int(B), 1))
+        return 1
+
+    def _phase_counts(self, tail_elems: int) -> dict:
+        """Structural per-apply counts per phase (``obs/phases.py``
+        taxonomy), this rank's addressable shards only — pure functions of
+        the plan geometry the engine already knows, cached per
+        (mode, program, tail), exact by construction (pinned in
+        ``tests/test_phases.py``):
+
+        * ``plan_h2d``   streamed mode's per-apply plan bytes (one full
+          stream per ≤4-column group — the k>4 re-stream policy);
+        * ``compute``    x gathers per structure entry (+ the send-side
+          ``x[qin]`` gather in ell/compact; the orbit scan in fused);
+        * ``exchange``   exactly :meth:`_exchange_nbytes`'s send volume;
+        * ``accumulate`` receive-side ``segment_sum`` slots (fused and
+          streamed) or the two-level tail scatter rows (ell/compact).
+        """
+        key = (self.mode, self._last_program_key, int(tail_elems))
+        cache = getattr(self, "_phase_count_cache", None)
+        if cache is None:
+            cache = self._phase_count_cache = {}
+        got = cache.get(key)
+        if got is not None:
+            return got
+        D, M, T = self.n_devices, self.shard_size, self.num_terms
+        nmy = self._n_my_shards
+        cplx = self.pair or not self.real
+        k = max(tail_elems // 2 if self.pair else tail_elems, 1)
+        vb = 16 if cplx else 8            # one vector value
+        fmul = 8 if cplx else 2           # multiply-add flops per column
+        xbytes = self._exchange_nbytes_tail(int(tail_elems))
+        c = obs_phases.zero_counts()
+        c["exchange"]["bytes"] = xbytes
+        if self.mode in ("ell", "compact"):
+            C = self.query_capacity
+            tail = self._ell_tail if self.mode == "ell" else self._c_tail
+            cfb = (16 if cplx else 8) if self.mode == "ell" else 4 + 8
+            g_tail = int(tail[1].shape[1] * tail[1].shape[2]) if tail else 0
+            rows_t = int(tail[0].shape[1]) if tail else 0
+            g = nmy * (self._ell_T0 * M + g_tail + D * C)
+            c["compute"] = {"bytes": g * (vb * k + cfb), "gathers": g,
+                            "flops": g * k * fmul}
+            c["accumulate"] = {"bytes": nmy * rows_t * vb * k,
+                               "gathers": nmy * rows_t,
+                               "flops": nmy * rows_t * k * (2 if cplx else 1)}
+        else:
+            nch = self._nchunks()
+            Cap = self._last_capacity or self._capacity
+            B = self.batch_size if self.mode == "streamed" \
+                else int(self._last_program_key or self.batch_size)
+            seg = nmy * nch * D * Cap
+            c["accumulate"] = {"bytes": seg * vb * k, "gathers": seg,
+                               "flops": seg * k * (2 if cplx else 1)}
+            ent = nmy * nch * B * T
+            if self.mode == "streamed":
+                ngroups = -(-k // 4) if k > 4 else 1
+                c["plan_h2d"]["bytes"] = int(self.plan_bytes) * ngroups
+                c["compute"] = {"bytes": ent * vb * k, "gathers": 0,
+                                "flops": ent * k * fmul}
+            else:
+                grp = getattr(self.operator.basis, "group", None)
+                G = max(len(grp), 1) if grp is not None else 1
+                c["compute"] = {"bytes": ent * vb * k, "gathers": ent,
+                                "flops": ent * (k * fmul
+                                                + G * obs_phases.ORBIT_OPS)}
+        cache[key] = c
+        return c
 
     def _exchange_nbytes(self, xh) -> int:
         """Estimated per-rank ``all_to_all`` send volume for ONE apply of
         ``xh`` (this rank's addressable shards only).  ELL/compact send
         exactly the padded [D, C] query payload per shard; fused mode sends
         the fixed-capacity state+amplitude buckets per row chunk."""
-        D = self.n_devices
-        if D <= 1:
-            return 0
         tail_elems = 1
         for s in xh.shape[2:]:
             tail_elems *= int(s)
+        return self._exchange_nbytes_tail(tail_elems)
+
+    def _exchange_nbytes_tail(self, tail_elems: int) -> int:
+        """:meth:`_exchange_nbytes` from the trailing element count alone
+        (shared with the phase accounting, which has no ``xh`` in hand)."""
+        D = self.n_devices
+        if D <= 1:
+            return 0
         nmy = self._n_my_shards
         if self.mode in ("ell", "compact"):
             return nmy * D * self.query_capacity * tail_elems * 8

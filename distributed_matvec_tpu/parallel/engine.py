@@ -43,6 +43,7 @@ import numpy as np
 
 from ..models.operator import Operator
 from ..obs import annotate, counter, emit, gauge, histogram
+from ..obs import phases as obs_phases
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
 from ..obs.events import obs_enabled
@@ -1463,15 +1464,76 @@ class LocalEngine:
             # reduction over y (a separate tiny program: the apply program
             # itself is byte-identical with probes on or off)
             obs_health.drain()
-            if obs_health.probe_due(self._apply_idx):
-                obs_health.probe_apply("local", y, self._apply_idx)
-            if obs_memory.watermark_due(self._apply_idx):
-                obs_memory.sample_watermark("apply/local",
-                                            apply=self._apply_idx)
+            idx = self._apply_idx
+            if obs_health.probe_due(idx):
+                obs_health.probe_apply("local", y, idx)
+            if obs_memory.watermark_due(idx):
+                obs_memory.sample_watermark("apply/local", apply=idx)
             self._apply_idx += 1
-        histogram("matvec_apply_ms", engine="local").observe(
-            (time.perf_counter() - _t0) * 1e3)
+        dt_ms = (time.perf_counter() - _t0) * 1e3
+        if obs_enabled():
+            # same per-apply event the distributed engine emits (bytes = 0:
+            # no exchange), so merge/report --phases read every mode's
+            # applies uniformly
+            emit("matvec_apply", engine="local", apply=idx,
+                 wall_ms=round(dt_ms, 4), bytes=0)
+            nd_base = 2 if self.pair else 1
+            k = int(np.shape(x)[1]) if np.ndim(x) == nd_base + 1 else 1
+            obs_phases.emit_apply_phases(
+                "local", self.mode, idx, dt_ms, self._phase_counts(k),
+                chunks=self.num_chunks if self.mode == "fused" else 1,
+                columns=k)
+        histogram("matvec_apply_ms", engine="local").observe(dt_ms)
         return K.complex_from_pair(np.asarray(y)) if was_complex else y
+
+    def _phase_counts(self, columns: int) -> dict:
+        """Structural per-apply counts per phase (``obs/phases.py``
+        taxonomy) — pure functions of the engine geometry, cached per
+        column count, exact by construction (pinned in
+        ``tests/test_phases.py``):
+
+        * ``compute``   one x-row gather per structure entry (table slots
+          including ELL padding — the gather executes for every slot) plus
+          the streamed coefficient; fused mode adds the orbit-scan ops.
+        * ``accumulate`` the tail scatter-add rows (ell/compact two-level
+          tail); zero in fused mode (pure row form).
+        """
+        cache = getattr(self, "_phase_count_cache", None)
+        if cache is None:
+            cache = self._phase_count_cache = {}
+        got = cache.get((self.mode, columns))
+        if got is not None:
+            return got
+        k = max(int(columns), 1)
+        cplx = self.pair or not self.real
+        vb = 16 if cplx else 8            # one vector value
+        fmul = 8 if cplx else 2           # multiply-add flops per column
+        c = obs_phases.zero_counts()
+        if self.mode in ("ell", "compact"):
+            if self.mode == "ell":
+                tail = self._ell_tail
+                cfb = 16 if cplx else 8   # streamed f64/pair coefficient
+            else:
+                tail = self._c_tail
+                cfb = 4 + 8               # sign-tagged i32 + gathered norm
+            T0 = self._ell_T0
+            g_main = T0 * self.n_padded
+            g_tail = int(tail[1].shape[0] * tail[1].shape[1]) if tail else 0
+            rows_t = int(tail[0].shape[0]) if tail else 0
+            g = g_main + g_tail
+            c["compute"] = {"bytes": g * (vb * k + cfb), "gathers": g,
+                            "flops": g * k * fmul}
+            c["accumulate"] = {"bytes": rows_t * vb * k, "gathers": rows_t,
+                               "flops": rows_t * k * (2 if cplx else 1)}
+        else:                             # fused: scan + route per apply
+            grp = getattr(self.operator.basis, "group", None)
+            G = max(len(grp), 1) if grp is not None else 1
+            g = self.n_padded * self.num_terms
+            c["compute"] = {"bytes": g * vb * k, "gathers": g,
+                            "flops": g * (k * fmul
+                                          + G * obs_phases.ORBIT_OPS)}
+        cache[(self.mode, columns)] = c
+        return c
 
     def _validate_counter(self, bad: int) -> None:
         if bad != 0:

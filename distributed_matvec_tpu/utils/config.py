@@ -45,6 +45,11 @@ class RuntimeConfig:
     #   device.memory_stats() into hbm_bytes_in_use/hbm_peak_bytes gauges
     #   and a memory_watermark event; backends without stats (CPU) latch
     #   off after the first miss (obs/memory.py)
+    phases: str = "on"                     # per-apply phase attribution
+    #   (DMT_PHASES): "on" emits one `apply_phases` event per eager apply
+    #   (host-side structural counts only — the apply HLO is byte-identical
+    #   on or off, guard-tested by `make roofline-check`); "off" disables
+    #   the events (obs off implies off)
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

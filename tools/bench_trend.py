@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""bench_trend — track and gate the cross-PR benchmark trajectory.
+
+The repo accumulates per-round benchmark artifacts (BENCH_r0*.json,
+BENCH_STREAM_r05.json, BENCH_DETAIL.json), but nothing tracked the
+*trajectory*: a PR that quietly gave back half of round 5's streamed
+speedup would pass every per-run gate.  This tool closes that loop:
+
+* ``bench.py`` appends ONE compact record per bench run to
+  ``PROGRESS.jsonl`` (the repo's append-only progress ledger — trend
+  records carry ``"kind": "bench_trend"`` and readers here skip every
+  other line, so the driver's own records are untouched)::
+
+      {"kind": "bench_trend", "ts": ..., "mode": "smoke|full|cpu_fallback",
+       "backend": "cpu", "configs": {name: {metric: value, ...}}}
+
+* ``trend`` renders the per-(config, metric) trajectory across records;
+* ``gate`` compares the NEWEST record against the best earlier record of
+  the same (mode, backend) — direction-aware exactly like
+  ``obs_report diff`` (ms/bytes up is a regression, iters-per-second /
+  speedups down is) — and exits 1 beyond the threshold.  Configs whose
+  ``n_states`` changed between records are skipped (a re-scoped config is
+  a different experiment, not a regression).
+
+Subcommands::
+
+    append --detail BENCH_DETAIL.json [--progress PATH] [--mode M]
+           [--backend B]
+    trend  [--progress PATH] [--config C ...] [--metric M ...] [--last N]
+           [--json]
+    gate   [--progress PATH] [--threshold 0.3] [--metric M ...]
+           [--config C ...] [--baseline best|prev]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import obs_report  # noqa: E402  (direction rules live in ONE place)
+
+KIND = "bench_trend"
+
+#: Metrics worth carrying across PRs (compact: one line per run).  Any
+#: ``phase_*`` metric rides along too (per-phase bytes/gathers from the
+#: apply_phases instrumentation — what a plan-compression PR gates on).
+METRIC_WHITELIST = (
+    "n_states", "device_ms", "batch4_ms_per_vector", "lanczos_iters_per_s",
+    "lanczos_e0", "engine_init_s", "table_bytes", "peak_hbm_bytes",
+    "fused_steady_apply_ms", "streamed_steady_apply_ms",
+    "stream_steady_speedup", "plan_bytes", "plan_build_s",
+    "plan_stream_stall_ms", "apply_wall_ms", "speedup_vs_numpy",
+)
+
+#: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
+#: ``obs_report diff``).
+DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
+                "lanczos_iters_per_s")
+
+
+def _keep(metric: str) -> bool:
+    return metric in METRIC_WHITELIST or metric.startswith("phase_")
+
+
+def compact_record(detail: dict, mode: str, backend: str,
+                   ts: Optional[float] = None) -> dict:
+    """One trend record from a BENCH_DETAIL-style dict
+    (``{config_key: {metrics...}}``, ``main`` included)."""
+    configs: Dict[str, dict] = {}
+    for key, rec in sorted(detail.items()):
+        if not isinstance(rec, dict) or "error" in rec:
+            continue
+        name = str(rec.get("config", key))
+        vals = {m: v for m, v in rec.items()
+                if _keep(m) and isinstance(v, (int, float))
+                and not isinstance(v, bool)}
+        if vals:
+            configs[name] = vals
+    return {"kind": KIND, "ts": round(ts if ts is not None else time.time(),
+                                      3),
+            "mode": str(mode), "backend": str(backend), "configs": configs}
+
+
+def append_record(path: str, record: dict) -> bool:
+    """Append one record line (soft-fail: an unwritable checkout must not
+    cost the bench run)."""
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError as e:
+        print(f"[bench_trend] append to {path} failed: {e!r}",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def load_records(path: str) -> List[dict]:
+    """The ``bench_trend`` records of a PROGRESS.jsonl (other lines —
+    the driver's own progress records — are skipped), oldest first."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue        # a torn/foreign line is not ours to judge
+            if isinstance(rec, dict) and rec.get("kind") == KIND:
+                out.append(rec)
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out
+
+
+def _comparable(records: List[dict], newest: dict) -> List[dict]:
+    """Earlier records of the newest record's (mode, backend)."""
+    return [r for r in records[:-1]
+            if r.get("mode") == newest.get("mode")
+            and r.get("backend") == newest.get("backend")]
+
+
+def gate(records: List[dict], threshold: float,
+         gate_metrics: Optional[List[str]] = None,
+         configs: Optional[List[str]] = None,
+         baseline: str = "best"):
+    """(rows, regressions) for the newest record vs its baseline.
+
+    ``baseline="best"`` (default) compares against the best earlier value
+    per (config, metric) — the trajectory must not give back ground;
+    ``"prev"`` compares against the immediately preceding record only.
+    """
+    gates = list(gate_metrics) if gate_metrics else list(DEFAULT_GATE)
+
+    def _gated(metric: str) -> bool:
+        return any(metric == g or (g.endswith("*")
+                                   and metric.startswith(g[:-1]))
+                   for g in gates)
+
+    rows, regressions = [], []
+    if len(records) < 2:
+        return rows, regressions, None
+    newest = records[-1]
+    earlier = _comparable(records, newest)
+    if baseline == "prev":
+        earlier = earlier[-1:]
+    if not earlier:
+        return rows, regressions, newest
+    for cfg, vals in sorted(newest.get("configs", {}).items()):
+        if configs and not any(sel in cfg for sel in configs):
+            continue
+        for metric, nv in sorted(vals.items()):
+            if not _gated(metric):
+                continue
+            hib = obs_report._is_higher_better(metric)
+            cand = []
+            for r in earlier:
+                old = r.get("configs", {}).get(cfg)
+                if not old or metric not in old:
+                    continue
+                # a config whose basis size changed is a different
+                # experiment — never a trend regression
+                if ("n_states" in old and "n_states" in vals
+                        and old["n_states"] != vals["n_states"]):
+                    continue
+                cand.append(float(old[metric]))
+            if not cand:
+                continue
+            b = max(cand) if hib else min(cand)
+            if not b:
+                continue
+            rel = (float(nv) - b) / abs(b)
+            worse = -rel if hib else rel
+            rows.append((cfg, metric, b, float(nv), rel))
+            if worse > threshold:
+                regressions.append((cfg, metric, b, float(nv), rel))
+    return rows, regressions, newest
+
+
+def render_trend(records: List[dict], configs: Optional[List[str]],
+                 metrics: Optional[List[str]], last: int) -> None:
+    recs = records[-last:]
+    if not recs:
+        print("no bench_trend records yet — run bench.py (it appends one "
+              "per run) or `bench_trend append --detail BENCH_DETAIL.json`")
+        return
+    print(f"{len(records)} record(s); showing last {len(recs)} "
+          f"(oldest -> newest):")
+    for r in recs:
+        when = time.strftime("%Y-%m-%d %H:%M", time.localtime(r["ts"]))
+        print(f"  {when}  mode={r.get('mode'):<12} "
+              f"backend={r.get('backend'):<4} "
+              f"configs={len(r.get('configs', {}))}")
+    series: Dict[tuple, List[Optional[float]]] = {}
+    for i, r in enumerate(recs):
+        for cfg, vals in r.get("configs", {}).items():
+            if configs and not any(sel in cfg for sel in configs):
+                continue
+            for m, v in vals.items():
+                if m == "n_states":
+                    continue
+                if metrics and not any(sel in m for sel in metrics):
+                    continue
+                series.setdefault((cfg, m), [None] * len(recs))[i] = float(v)
+    if not series:
+        print("no matching (config, metric) series")
+        return
+    print(f"\n  {'config':<26} {'metric':<28} {'first':>10} {'last':>10} "
+          f"{'change':>8}  trajectory")
+    for (cfg, m), vals in sorted(series.items()):
+        present = [v for v in vals if v is not None]
+        if not present:
+            continue
+        first, lastv = present[0], present[-1]
+        rel = (lastv - first) / abs(first) if first else 0.0
+        traj = " ".join("-" if v is None else f"{v:.4g}" for v in vals)
+        print(f"  {cfg:<26} {m:<28} {first:>10.4g} {lastv:>10.4g} "
+              f"{rel:>+7.1%}  {traj}")
+
+
+def default_progress_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PROGRESS.jsonl")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_trend", description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("append", help="append one compact record from a "
+                                      "bench detail JSON")
+    p.add_argument("--detail", required=True,
+                   help="BENCH_DETAIL-style JSON ({config: {metrics}})")
+    p.add_argument("--progress", default=None, metavar="PATH")
+    p.add_argument("--mode", default="manual")
+    p.add_argument("--backend", default="unknown")
+
+    p = sub.add_parser("trend", help="render the cross-run trajectory")
+    p.add_argument("--progress", default=None, metavar="PATH")
+    p.add_argument("--config", action="append", default=None)
+    p.add_argument("--metric", action="append", default=None)
+    p.add_argument("--last", type=int, default=8)
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("gate", help="newest record vs the trajectory "
+                                    "(exit 1 on regression)")
+    p.add_argument("--progress", default=None, metavar="PATH")
+    p.add_argument("--threshold", type=float, default=0.3,
+                   help="relative regression bound (default 0.3 — looser "
+                        "than obs-check's 0.2: trend records span "
+                        "machine-state drift, not one warm process)")
+    p.add_argument("--metric", action="append", default=None,
+                   help="gate on this metric (repeatable; `*` suffix = "
+                        "prefix match; default: device_ms, "
+                        "streamed_steady_apply_ms, lanczos_iters_per_s)")
+    p.add_argument("--config", action="append", default=None)
+    p.add_argument("--baseline", choices=("best", "prev"), default="best")
+
+    args = ap.parse_args(argv)
+    progress = args.progress or default_progress_path()
+
+    if args.cmd == "append":
+        with open(args.detail) as f:
+            detail = json.load(f)
+        rec = compact_record(detail, args.mode, args.backend)
+        if not rec["configs"]:
+            print("[bench_trend] no usable configs in the detail JSON",
+                  file=sys.stderr)
+            return 2
+        ok = append_record(progress, rec)
+        print(f"[bench_trend] appended {len(rec['configs'])} config(s) "
+              f"to {progress}" if ok else "[bench_trend] append failed")
+        return 0 if ok else 1
+
+    records = load_records(progress)
+
+    if args.cmd == "trend":
+        if args.json:
+            print(json.dumps(records[-args.last:], indent=1,
+                             sort_keys=True))
+        else:
+            render_trend(records, args.config, args.metric, args.last)
+        return 0
+
+    rows, regressions, newest = gate(records, args.threshold, args.metric,
+                                     args.config, args.baseline)
+    if newest is None:
+        print("[bench_trend] fewer than 2 records — nothing to gate")
+        return 0
+    if not rows:
+        print("[bench_trend] no comparable gated series (first run of "
+              "this mode/backend, or configs changed size) — pass")
+        return 0
+    print(f"gated series vs {args.baseline} of "
+          f"{len(_comparable(records, newest))} earlier "
+          f"{newest.get('mode')}/{newest.get('backend')} record(s):")
+    for cfg, metric, b, n, rel in rows:
+        mark = "REGRESSED" if (cfg, metric, b, n, rel) in regressions else ""
+        print(f"  {cfg:<26} {metric:<28} {b:>10.4g} -> {n:>10.4g} "
+              f"({rel:+.1%}) {mark}")
+    if regressions:
+        print(f"\nREGRESSION: {len(regressions)} gated series beyond "
+              f"{args.threshold:.0%}")
+        return 1
+    print(f"\nno trend regression beyond {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

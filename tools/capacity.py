@@ -129,16 +129,40 @@ def stream_plan_bytes_per_row(num_terms: int, pair: bool) -> float:
     return num_terms * (4 + cf) * 1.10
 
 
+def load_rate_calibration(path: Optional[str] = None) -> Optional[dict]:
+    """The measured-rates calibration sidecar ``tools/gather_bound.py``
+    persists (``obs/roofline.py``) — explicit path, else the
+    content-addressed default; None when neither exists.  Shared with the
+    roofline report so both planners price applies at the same rates.
+    An explicit path that does not load raises (never a silent drop of
+    the est_apply_ms column the user asked for)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from distributed_matvec_tpu.obs import roofline
+    except ImportError:
+        return None
+    cal = roofline.load_calibration(path)
+    if path and cal is None:
+        raise FileNotFoundError(
+            f"calibration file {path} is missing or carries no rate "
+            "fields (expected a tools/gather_bound.py JSON)")
+    return cal
+
+
 def plan(n_states: int, num_terms: int, T0: int, pair: bool,
          hbm_gb: float, n_devices: int, vectors: int, vec_width: int,
          measured: Optional[dict] = None,
          utilization: float = DEFAULT_UTILIZATION,
-         host_ram_gb: float = 64.0) -> dict:
+         host_ram_gb: float = 64.0,
+         rates: Optional[dict] = None) -> dict:
     """The capacity report: bytes/row, max basis per device and per mesh
     for each mode, plus (optionally) measured calibration.  The streamed
     mode is additionally bounded by HOST RAM (``host_ram_gb``, per rank —
     one rank per device assumed): its resolved plan streams from there,
-    so the binding constraint is min(device rows, host plan rows)."""
+    so the binding constraint is min(device rows, host plan rows).  With a
+    ``rates`` calibration (gather_bound sidecar) each mode also gets an
+    ``est_apply_ms`` gather/stream-bound apply-time estimate."""
     T0 = int(T0) if T0 else int(num_terms)
     per_mode = mode_bytes_per_row(T0, pair)
     plan_row = stream_plan_bytes_per_row(int(num_terms), pair)
@@ -165,6 +189,11 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
             out["calibration"] = dict(
                 out["calibration"],
                 plan_bytes_per_row_measured=round(plan_row, 2))
+    if rates:
+        out["rates"] = {k: rates.get(k) for k in
+                        ("gather_rows_per_s", "h2d_bytes_per_s",
+                         "backend", "device_kind", "source")}
+    rows_share = n_states / max(n_devices, 1)
     for mode, struct_bytes in per_mode.items():
         row = struct_bytes + common
         rows_dev = int(budget // row)
@@ -175,6 +204,21 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
         if mode == "streamed":
             entry["host_plan_bytes_per_row"] = round(plan_row, 2)
             rows_dev = min(rows_dev, int(host_budget // plan_row))
+        if rates and rates.get("gather_rows_per_s"):
+            # gather-roofline apply-time estimate per device shard at the
+            # calibrated rates: ell/compact gather T0 entries/row; fused
+            # scans T per row (the orbit-scan constant is in the flops
+            # term the roofline model carries — this is the gather floor);
+            # streamed is bounded by its plan stream (h2d bytes)
+            g = float(rates["gather_rows_per_s"])
+            if mode in ("ell", "compact", "fused"):
+                per = T0 if mode in ("ell", "compact") else int(num_terms)
+                entry["est_apply_ms"] = round(
+                    rows_share * per / g * 1e3, 3)
+            elif rates.get("h2d_bytes_per_s"):
+                entry["est_apply_ms"] = round(
+                    rows_share * plan_row
+                    / float(rates["h2d_bytes_per_s"]) * 1e3, 3)
         entry.update({
             "max_rows_per_device": rows_dev,
             "max_basis_size": rows_dev * n_devices,
@@ -230,16 +274,27 @@ def print_report(report: dict, rec: dict) -> None:
               f"{cal.get('table_bytes', 0) / 1e9:.3f} GB tables"
               + (f" = {cal['bytes_per_row_measured']} B/row"
                  if "bytes_per_row_measured" in cal else ""))
+    rates = report.get("rates")
+    if rates:
+        print(f"  rate calibration ({rates.get('source')}, "
+              f"{rates.get('backend')}): gather "
+              f"{(rates.get('gather_rows_per_s') or 0) / 1e6:.0f} M rows/s, "
+              f"h2d {(rates.get('h2d_bytes_per_s') or 0) / 1e9:.1f} GB/s")
+    est_col = any("est_apply_ms" in report["modes"][m]
+                  for m in report["modes"])
     print(f"  {'mode':<9} {'struct B/row':>13} {'total B/row':>12} "
-          f"{'max rows/device':>16} {'max basis (mesh)':>17}  fits N?")
+          f"{'max rows/device':>16} {'max basis (mesh)':>17}"
+          + (f" {'est ms/apply':>13}" if est_col else "") + "  fits N?")
     for mode in ("ell", "compact", "streamed", "fused"):
         m = report["modes"][mode]
         note = (f"  (+{m['host_plan_bytes_per_row']:.0f} B/row host plan)"
                 if "host_plan_bytes_per_row" in m else "")
+        est = (f" {m['est_apply_ms']:>13,.1f}" if "est_apply_ms" in m
+               else (" " * 14 if est_col else ""))
         print(f"  {mode:<9} {m['structure_bytes_per_row']:>13.1f} "
               f"{m['bytes_per_row']:>12.1f} "
               f"{m['max_rows_per_device']:>16,} "
-              f"{m['max_basis_size']:>17,}  "
+              f"{m['max_basis_size']:>17,} {est} "
               f"{'yes' if m['fits_n_states'] else 'no'}{note}")
     print(f"  recommendation: {rec['note']}")
 
@@ -274,6 +329,11 @@ def main(argv=None) -> int:
                     help="RHS columns per vector (multi-RHS batches)")
     ap.add_argument("--target-n", type=float, default=None,
                     help="recommend mode/shards for this basis size")
+    ap.add_argument("--calibration", default=None, metavar="PATH",
+                    help="rate-calibration JSON from tools/gather_bound.py "
+                         "(default: the content-addressed sidecar under "
+                         "the artifact root, when present) — adds "
+                         "gather/stream-bound est_apply_ms per mode")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -321,7 +381,8 @@ def main(argv=None) -> int:
     report = plan(n_states, num_terms, T0, pair, args.hbm_gb, n_devices,
                   args.vectors, args.vec_width, measured=measured,
                   utilization=args.utilization,
-                  host_ram_gb=args.host_ram_gb)
+                  host_ram_gb=args.host_ram_gb,
+                  rates=load_rate_calibration(args.calibration))
     rec = recommend(report, int(args.target_n) if args.target_n else None)
     if args.json:
         print(json.dumps({"report": report, "recommendation": rec},

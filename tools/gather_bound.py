@@ -28,6 +28,14 @@ chaining CH applications inside one jitted program):
 
 Usage: python tools/gather_bound.py [--full]   (--full includes the
 4.7M-row chain_32_symm engine breakdown; several minutes of build time)
+
+Every run also PERSISTS its measured rates as a content-addressed
+calibration sidecar (``calibration/<fp>.json`` under the artifact root,
+keyed by backend + device kind — ``obs/roofline.py``), consumed by
+``tools/capacity.py`` (per-mode apply-time estimates) and
+``tools/obs_report.py roofline`` (achieved-vs-bound fractions) instead of
+the print-and-discard the script used to be.  ``--no-save`` skips the
+sidecar; ``--calibration-out PATH`` writes an explicit copy.
 """
 
 import argparse
@@ -107,6 +115,21 @@ def gather_rate(n_rows: int, width: int, pattern: str = "random") -> float:
     return g / dt / 1e6
 
 
+def h2d_rate(nbytes: int = 1 << 26) -> float:
+    """Measured host→device transfer bandwidth (bytes/s): time device_put
+    of an ``nbytes`` f32 buffer, fetch-synced like every other timing
+    here (the plan-stream phase bound `obs/roofline.py` divides by)."""
+    rng = np.random.default_rng(1)
+    a = rng.random(nbytes // 4, dtype=np.float32)
+    s = np.asarray(jnp.sum(jax.device_put(a)))    # warm the path
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        s = np.asarray(jnp.sum(jax.device_put(a)))
+    del s
+    per = (time.perf_counter() - t0) / REPS - _fetch_latency()
+    return nbytes / max(per, 1e-9)
+
+
 def engine_breakdown():
     """Gathers-only vs full matvec on the BASELINE headline basis."""
     from distributed_matvec_tpu.models.basis import SpinBasis
@@ -149,31 +172,77 @@ def engine_breakdown():
 
     g_only = _time_chain(jax.jit(chain_g), x, operands)
     n_gathers = Npad * T0
+    out = {"config": "chain_32_symm", "n_states": int(N), "T0": int(T0),
+           "full_ms": round(full * 1e3, 3),
+           "gathers_only_ms": round(g_only * 1e3, 3),
+           "engine_rows_per_s": n_gathers / g_only,
+           "gather_share": g_only / full}
     print(f"chain_32_symm: N={N} T0={T0}  full {full*1e3:.0f} ms, "
           f"gathers-only {g_only*1e3:.0f} ms "
           f"({n_gathers/g_only/1e6:.0f} M rows/s; engine at "
           f"{100*g_only/full:.0f}% gather share)")
+    return out
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="include the chain_32_symm engine breakdown")
+    ap.add_argument("--no-save", action="store_true",
+                    help="do not persist the calibration sidecar")
+    ap.add_argument("--calibration-out", default=None, metavar="PATH",
+                    help="also write the calibration JSON here")
+    ap.add_argument("--quick", action="store_true",
+                    help="small tables only (CI-speed calibration: the "
+                         "rates are slightly optimistic vs the 4.7M-row "
+                         "truth, but measured beats default)")
     args = ap.parse_args()
-    print(f"backend: {jax.default_backend()}")
+    backend = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    print(f"backend: {backend} ({device_kind})")
 
-    print("\n-- locality (4.7M-row [.,3] f32 table) --")
+    big = 1 << 18 if args.quick else 4_718_592
+    print(f"\n-- locality ({big}-row [.,3] f32 table) --")
+    rates = {}
     for pat in ("random", "sorted", "banded", "identity"):
-        print(f"  {pat:>9}: {gather_rate(4_718_592, 3, pat):6.0f} M rows/s")
+        rates[pat] = gather_rate(big, 3, pat)
+        print(f"  {pat:>9}: {rates[pat]:6.0f} M rows/s")
 
-    print("\n-- row width (2M-row table, random) --")
+    wtab = 1 << 16 if args.quick else 1 << 21
+    print(f"\n-- row width ({wtab}-row table, random) --")
+    widths = {}
     for w in (3, 6, 12):
-        r = gather_rate(1 << 21, w)
+        r = gather_rate(wtab, w)
+        widths[w] = r
         print(f"  width {w:>2}: {r:6.0f} M rows/s = {r*w/1e3:5.1f} G elem/s")
 
+    h2d = h2d_rate(1 << 22 if args.quick else 1 << 26)
+    print(f"\n-- h2d bandwidth: {h2d/1e9:.2f} GB/s --")
+
+    breakdown = None
     if args.full:
         print()
-        engine_breakdown()
+        breakdown = engine_breakdown()
+
+    # persist what the roofline model and capacity planner consume: the
+    # width-3 random-index rate IS the engines' split-row gather bound
+    from distributed_matvec_tpu.obs import roofline as _roofline
+
+    cal = dict(_roofline.default_calibration(backend),
+               backend=str(backend), device_kind=str(device_kind),
+               gather_rows_per_s=rates["random"] * 1e6,
+               h2d_bytes_per_s=h2d,
+               gather_table_rows=int(big),
+               width_rates_m_rows_per_s={str(w): round(r, 1)
+                                         for w, r in widths.items()})
+    if breakdown:
+        cal["engine_breakdown"] = breakdown
+    if args.calibration_out:
+        _roofline.save_calibration(cal, args.calibration_out)
+        print(f"calibration written to {args.calibration_out}")
+    if not args.no_save:
+        path = _roofline.save_calibration(cal)
+        print(f"calibration sidecar: {path or 'artifact layer off'}")
 
 
 if __name__ == "__main__":

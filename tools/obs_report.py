@@ -33,25 +33,44 @@ Subcommands::
         ``(ts_adj, rank, seq)`` (within-rank ``seq`` order is monotonic and
         trusted; wall clocks across hosts are not).
 
-    report RUN [--ranks] [--memory] [--json]
+    report RUN [--ranks] [--memory] [--phases] [--json]
         Cross-rank skew report: estimated clock offsets, straggler
         attribution per apply (the rank whose aligned ``matvec_apply``
         lands last; excess = max − median), and with ``--ranks`` the
         per-rank table — events, survivor states, bytes exchanged,
         plan-build wall, double-buffer stalls, per-rank peak HBM, mean
         time-at-barrier.  ``--memory`` appends the memory section
-        (ledger / watermarks / executables / OOM reports).
+        (ledger / watermarks / executables / OOM reports); ``--phases``
+        the per-(engine, mode) phase table from ``apply_phases`` events
+        (mean apply wall, per-phase bytes/gathers, measured plan-stream
+        waits).
+
+    roofline RUN [--calibration PATH] [--json]
+        The analytical roofline report (``obs/roofline.py``) over the
+        run's ``apply_phases`` events: per (engine, mode) the attributed
+        per-phase wall times (summing to the measured apply wall),
+        bound times at the calibrated rates, achieved-vs-bound fractions,
+        the named binding resource, and the pipelined-apply speedup
+        estimate (the ROADMAP's overlap item, priced before it's built).
+        Calibration: explicit ``--calibration`` JSON > the
+        content-addressed sidecar ``tools/gather_bound.py`` persists >
+        the documented DESIGN.md §2 defaults.
 
     diff BASELINE NEW [--threshold 0.2] [--metric device_ms ...]
-                      [--config NAME ...] [--memory] [--all-metrics]
+                      [--config NAME ...] [--memory] [--phases]
+                      [--all-metrics]
         Two runs → per-config relative change of every comparable numeric
         metric; exits 1 when any *gated* metric regressed beyond the
         threshold (default gate: device_ms; direction-aware — ms/seconds
         up is a regression, iters-per-second down is).  ``--memory`` adds
         the memory gate (table_bytes, executable temp/peak bytes,
-        watermark peak — growth is the regression).  This is the CI
-        perf gate `make obs-check` runs against the recorded
-        BENCH_DETAIL.json.
+        watermark peak — growth is the regression); ``--phases`` gates
+        every ``phase_*`` bench metric (per-phase bytes/gathers/ms — all
+        cost-like), so a plan-compression PR can assert "H2D phase bytes
+        down, compute phase flat" with
+        ``--phases`` or ``--metric phase_plan_h2d_bytes``.  A gate entry
+        ending in ``*`` matches by prefix.  This is the CI perf gate
+        `make obs-check` runs against the recorded BENCH_DETAIL.json.
 
     tail RUN [-n 20] [--follow]
         Human-readable view of the last events; ``--follow`` keeps reading
@@ -81,6 +100,10 @@ _DEFAULT_GATE = ("device_ms",)
 # direction rule above already reads growth as the regression
 _MEMORY_GATE = ("table_bytes", "executable_temp_bytes",
                 "executable_peak_bytes", "peak_hbm_bytes")
+
+# the phase gate (`diff --phases`): every per-phase bench metric
+# (phase_<name>_bytes / _gathers / _ms) — all cost-like, prefix-matched
+_PHASE_GATE = ("phase_*",)
 
 
 def _is_higher_better(metric: str) -> bool:
@@ -260,6 +283,79 @@ def memory_summary(events: List[dict], top_n: int = 8) -> dict:
             "executables": analyses, "oom_events": ooms}
 
 
+_PHASE_ORDER = ("plan_h2d", "compute", "exchange", "accumulate", "overhead")
+
+
+def phases_summary(events: List[dict]) -> dict:
+    """Per-(engine, mode) digest of the ``apply_phases`` events: apply
+    count, mean wall (steady = first apply dropped when ≥2), per-phase
+    structural totals and mean measured walls, mean plan-stream chunk
+    stall.  Structural-only — the calibrated bound/attribution view lives
+    in the ``roofline`` subcommand (obs/roofline.py)."""
+    groups: Dict[str, List[dict]] = {}
+    for ev in events:
+        if ev.get("kind") == "apply_phases" and ev.get("phases"):
+            key = f"{ev.get('engine')}/{ev.get('mode')}"
+            groups.setdefault(key, []).append(ev)
+    out = {}
+    for key, evs in sorted(groups.items()):
+        steady = evs[1:] if len(evs) > 1 else evs
+        walls = [float(e.get("wall_ms") or 0.0) for e in steady]
+        phases: Dict[str, dict] = {}
+        for p in sorted({p for e in steady for p in e["phases"]}):
+            recs = [e["phases"].get(p) or {} for e in steady]
+            mws = [float(r["wall_ms"]) for r in recs
+                   if r.get("wall_ms") is not None]
+            phases[p] = {
+                "bytes": int(sum(r.get("bytes", 0) for r in recs)
+                             / max(len(recs), 1)),
+                "gathers": int(sum(r.get("gathers", 0) for r in recs)
+                               / max(len(recs), 1)),
+                "flops": int(sum(r.get("flops", 0) for r in recs)
+                             / max(len(recs), 1)),
+            }
+            if mws:
+                phases[p]["measured_wall_ms"] = round(
+                    sum(mws) / len(mws), 4)
+        stalls = [c["stall_ms"] for e in steady
+                  for c in (e.get("chunk_timeline") or [])
+                  if c.get("stall_ms") is not None]
+        out[key] = {
+            "applies": len(evs),
+            "mean_wall_ms": round(sum(walls) / len(walls), 4)
+            if walls else None,
+            "chunks": int(steady[-1].get("chunks") or 1),
+            "phases": phases,
+        }
+        if stalls:
+            out[key]["mean_chunk_stall_ms"] = round(
+                sum(stalls) / len(stalls), 4)
+    return out
+
+
+def print_phases_section(ph: dict) -> None:
+    """Render the :func:`phases_summary` digest (``summarize`` phases
+    section / ``report --phases``)."""
+    print("\nphase attribution (apply_phases; mean over steady applies):")
+    for key, grp in sorted(ph.items()):
+        print(f"  {key}: {grp['applies']} applies, "
+              f"wall {grp['mean_wall_ms']} ms/apply, "
+              f"{grp['chunks']} chunk(s)"
+              + (f", mean plan-stream stall "
+                 f"{grp['mean_chunk_stall_ms']} ms"
+                 if "mean_chunk_stall_ms" in grp else ""))
+        for p in _PHASE_ORDER:
+            rec = grp["phases"].get(p)
+            if rec is None or not any(rec.get(k) for k in
+                                      ("bytes", "gathers", "flops",
+                                       "measured_wall_ms")):
+                continue
+            mw = rec.get("measured_wall_ms")
+            print(f"    {p:<12} bytes={rec['bytes']:<14,} "
+                  f"gathers={rec['gathers']:<12,} flops={rec['flops']:,}"
+                  + (f"  measured {mw} ms" if mw is not None else ""))
+
+
 def run_summary(events: List[dict]) -> dict:
     """The machine-readable summary ``summarize`` renders."""
     inits = [{k: ev.get(k) for k in
@@ -318,6 +414,7 @@ def run_summary(events: List[dict]) -> dict:
             "health": {"counters": health_counters,
                        "events": health_events},
             "memory": memory_summary(events),
+            "phases": phases_summary(events),
             "bench": bench_metrics(events),
             "solvers": solvers}
 
@@ -373,6 +470,8 @@ def print_summary(s: dict) -> None:
     if any(mem.get(k) for k in ("top_allocations", "peak_hbm_bytes",
                                 "executables", "oom_events")):
         print_memory_section(mem)
+    if s.get("phases"):
+        print_phases_section(s["phases"])
     if s["bench"]:
         print("\nbench results:")
         for cfg, m in sorted(s["bench"].items()):
@@ -693,6 +792,14 @@ def diff_runs(base: Dict[str, Dict[str, float]],
     if configs:
         common = [c for c in common
                   if any(sel in c for sel in configs)]
+
+    def _gated(metric: str) -> bool:
+        # exact name, or prefix when the gate entry ends in `*`
+        # (`phase_*` — the --phases per-phase gate)
+        return any(metric == g or (g.endswith("*")
+                                   and metric.startswith(g[:-1]))
+                   for g in gate)
+
     for cfg in common:
         for metric in sorted(set(base[cfg]) & set(new[cfg])):
             b, n = base[cfg][metric], new[cfg][metric]
@@ -700,7 +807,7 @@ def diff_runs(base: Dict[str, Dict[str, float]],
                 continue
             rel = (n - b) / abs(b)
             worse = -rel if _is_higher_better(metric) else rel
-            gated = metric in gate
+            gated = _gated(metric)
             rows.append((cfg, metric, b, n, rel, gated))
             if gated and worse > threshold:
                 regressions.append((cfg, metric, b, n, rel))
@@ -877,8 +984,21 @@ def main(argv=None) -> int:
                    help="include the memory section (ledger top "
                         "allocations, watermark peaks, executable "
                         "analyses, OOM reports)")
+    p.add_argument("--phases", action="store_true",
+                   help="include the per-(engine, mode) phase table from "
+                        "apply_phases events (bytes/gathers per phase, "
+                        "measured plan-stream waits)")
     p.add_argument("--json", action="store_true",
                    help="print the machine-readable table dict")
+
+    p = sub.add_parser("roofline", help="analytical roofline over the "
+                                        "run's apply_phases events")
+    p.add_argument("run", help="run dir or .jsonl with apply_phases events")
+    p.add_argument("--calibration", default=None, metavar="PATH",
+                   help="rate-calibration JSON (tools/gather_bound.py); "
+                        "default: the content-addressed sidecar, else the "
+                        "DESIGN.md §2 documented defaults")
+    p.add_argument("--json", action="store_true")
 
     p = sub.add_parser("diff", help="two runs -> regression report "
                                     "(exit 1 on gated regression)")
@@ -894,6 +1014,10 @@ def main(argv=None) -> int:
                    help="also gate on memory regressions (table_bytes, "
                         "executable temp/peak bytes, watermark peak — all "
                         "direction-aware: growth is the regression)")
+    p.add_argument("--phases", action="store_true",
+                   help="also gate on every phase_* bench metric "
+                        "(per-phase bytes/gathers/ms — growth is the "
+                        "regression)")
     p.add_argument("--all-metrics", action="store_true",
                    help="print every common metric, not just gated/changed")
 
@@ -934,12 +1058,37 @@ def main(argv=None) -> int:
         table = rank_table(events)
         if args.memory:
             table["memory"] = memory_summary(events)
+        if args.phases:
+            table["phases"] = phases_summary(events)
         if args.json:
             print(json.dumps(table, indent=1, sort_keys=True))
         else:
             print_rank_report(table, show_ranks=args.ranks)
             if args.memory:
                 print_memory_section(table["memory"])
+            if args.phases:
+                print_phases_section(table["phases"])
+        return 0
+
+    if args.cmd == "roofline":
+        # the model lives in the package (obs/roofline.py) — imported
+        # lazily so every other subcommand stays standalone
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from distributed_matvec_tpu.obs import roofline as _roofline
+
+        events = load_events(args.run)
+        cal = _roofline.resolve_calibration(args.calibration)
+        report = _roofline.roofline_report(events, cal)
+        if not report["groups"]:
+            print(f"roofline: no apply_phases events in {args.run} — run "
+                  "with the obs layer on (DMT_PHASES defaults on)",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            _roofline.print_roofline(report)
         return 0
 
     if args.cmd == "diff":
@@ -948,6 +1097,8 @@ def main(argv=None) -> int:
         gate = list(args.metric) if args.metric else list(_DEFAULT_GATE)
         if args.memory:
             gate += [m for m in _MEMORY_GATE if m not in gate]
+        if args.phases:
+            gate += [m for m in _PHASE_GATE if m not in gate]
         rows, regressions, common = diff_runs(
             base, new, args.threshold, gate, args.config)
         print_diff(rows, regressions, common, args.threshold,
