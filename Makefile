@@ -13,7 +13,7 @@ HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
-	roofline-check clean
+	roofline-check compress-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -21,6 +21,7 @@ check:
 	$(MAKE) health-check
 	$(MAKE) mem-check
 	$(MAKE) stream-check
+	$(MAKE) compress-check
 	$(MAKE) roofline-check
 	$(MAKE) fault-check
 
@@ -89,6 +90,16 @@ mem-check:
 # disk writes, and the plan sidecar save/restore round-trip.
 stream-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/stream_check.py
+
+# Compressed-plan-stream gate (tools/compress_check.py): lossless/f32
+# codec round trip, the measured-error gate (lossless <= 1e-12 vs fused,
+# measured 0.0; f32 <= 1e-6), off-tier bit-identity with bitpacked rok,
+# the Pallas decode kernel (interpret) vs the XLA decode path, encoded
+# plan bytes >= 2.5x smaller gated via `obs_report diff --phases`
+# (phase_plan_h2d_bytes down, compute flat), and the PROGRESS.jsonl
+# trend gate guarding compress_ratio.  Deterministic, ~40 s on CPU.
+compress-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/compress_check.py
 
 # Phase-attribution gate (tools/roofline_check.py): apply HLO
 # byte-identity with phase probes on vs off (local ell + distributed

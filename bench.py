@@ -277,8 +277,10 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     return out
 
 
-def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1):
-    """Fused vs streamed DistributedEngine on one config.
+def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1,
+                  compress_tier="lossless"):
+    """Fused vs streamed vs compressed-streamed DistributedEngine on one
+    config.
 
     Records what the cold-apply numbers hide: ``plan_build_s`` and
     ``plan_bytes`` (the one-time structure resolution), per-mode
@@ -286,12 +288,18 @@ def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1):
     applies — where the streamed amortization lives), the
     ``plan_stream_stall_ms`` H2D wait, and the steady-state speedup the
     stream-check gate asserts.  Bit-identity of the streamed result
-    against fused rides along as a hard check."""
+    against fused rides along as a hard check.  The third leg re-streams
+    with ``stream_compress=<compress_tier>`` and records
+    ``plan_bytes_encoded`` / ``compress_ratio`` /
+    ``compressed_steady_apply_ms`` plus the measured relative error vs
+    fused — the numbers the PROGRESS.jsonl trend gate guards
+    (tools/bench_trend.py) and the compress-check gate asserts."""
     import jax
 
     from distributed_matvec_tpu.obs.metrics import histogram as _hist
     from distributed_matvec_tpu.parallel.distributed import DistributedEngine
     from distributed_matvec_tpu.utils.artifacts import make_or_restore_basis
+    from distributed_matvec_tpu.utils.config import get_config
 
     n_sites = basis_args["number_spins"]
     obs.emit("bench_config_start", config=name)
@@ -304,52 +312,76 @@ def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1):
     x = rng.standard_normal(n)
     x /= np.linalg.norm(x)
     y_ref = None
-    for mode in ("fused", "streamed"):
-        _progress(f"{name}: {mode} engine")
-        t0 = time.perf_counter()
-        eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
-        init_s = time.perf_counter() - t0
-        xh = eng.to_hashed(x)
-        stall = _hist("plan_stream_stall_ms")
-        stall_sum0, stall_n0 = stall.sum, stall.count
-        t0 = time.perf_counter()
-        yh = jax.block_until_ready(eng.matvec(xh))
-        first_ms = (time.perf_counter() - t0) * 1e3
-        t0 = time.perf_counter()
-        for _ in range(repeats):
-            yh = eng.matvec(xh)
-        jax.block_until_ready(yh)
-        steady_ms = (time.perf_counter() - t0) / repeats * 1e3
-        out[f"{mode}_init_s"] = round(init_s, 3)
-        out[f"{mode}_first_apply_ms"] = round(first_ms, 3)
-        out[f"{mode}_steady_apply_ms"] = round(steady_ms, 3)
-        if mode == "fused":
-            y_ref = np.asarray(yh)
-        else:
-            out["stream_bit_identical"] = bool(
-                np.array_equal(y_ref, np.asarray(yh)))
-            out["plan_bytes"] = int(eng.plan_bytes)
-            out["plan_build_s"] = round(
-                eng.timer.scope_total("build_plan"), 3)
-            napp = max(stall.count - stall_n0, 1)
-            out["plan_stream_stall_ms"] = round(
-                (stall.sum - stall_sum0) / napp, 4)
-            # per-phase columns from the last streamed apply (already
-            # instrumented — eng.matvec emitted apply_phases above)
-            pev = [e for e in obs.events("apply_phases")
-                   if e.get("engine") == "distributed"
-                   and e.get("mode") == "streamed"]
-            if pev:
-                for p, rec in pev[-1]["phases"].items():
-                    for fld in ("bytes", "gathers"):
-                        if rec.get(fld):
-                            out[f"phase_{p}_{fld}"] = int(rec[fld])
-                    if rec.get("wall_ms") is not None:
-                        out[f"phase_{p}_ms"] = rec["wall_ms"]
-        _progress(f"{name}: {mode} steady {steady_ms:.2f} ms/apply")
+    cfg = get_config()
+    saved_tier = cfg.stream_compress
+    legs = (("fused", None), ("streamed", "off"),
+            ("compressed", compress_tier))
+    try:
+        for leg, tier in legs:
+            mode = "fused" if leg == "fused" else "streamed"
+            if tier is not None:
+                cfg.stream_compress = tier
+            _progress(f"{name}: {leg} engine"
+                      + (f" (stream_compress={tier})"
+                         if leg == "compressed" else ""))
+            t0 = time.perf_counter()
+            eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+            init_s = time.perf_counter() - t0
+            xh = eng.to_hashed(x)
+            stall = _hist("plan_stream_stall_ms")
+            stall_sum0, stall_n0 = stall.sum, stall.count
+            t0 = time.perf_counter()
+            yh = jax.block_until_ready(eng.matvec(xh))
+            first_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                yh = eng.matvec(xh)
+            jax.block_until_ready(yh)
+            steady_ms = (time.perf_counter() - t0) / repeats * 1e3
+            out[f"{leg}_init_s"] = round(init_s, 3)
+            out[f"{leg}_first_apply_ms"] = round(first_ms, 3)
+            out[f"{leg}_steady_apply_ms"] = round(steady_ms, 3)
+            if leg == "fused":
+                y_ref = np.asarray(yh)
+            elif leg == "streamed":
+                out["stream_bit_identical"] = bool(
+                    np.array_equal(y_ref, np.asarray(yh)))
+                out["plan_bytes"] = int(eng.plan_bytes_raw)
+                out["plan_build_s"] = round(
+                    eng.timer.scope_total("build_plan"), 3)
+                napp = max(stall.count - stall_n0, 1)
+                out["plan_stream_stall_ms"] = round(
+                    (stall.sum - stall_sum0) / napp, 4)
+                # per-phase columns from the last streamed apply (already
+                # instrumented — eng.matvec emitted apply_phases above)
+                pev = [e for e in obs.events("apply_phases")
+                       if e.get("engine") == "distributed"
+                       and e.get("mode") == "streamed"]
+                if pev:
+                    for p, rec in pev[-1]["phases"].items():
+                        for fld in ("bytes", "gathers"):
+                            if rec.get(fld):
+                                out[f"phase_{p}_{fld}"] = int(rec[fld])
+                        if rec.get("wall_ms") is not None:
+                            out[f"phase_{p}_ms"] = rec["wall_ms"]
+            else:
+                y_c = np.asarray(yh)
+                scale = max(float(np.max(np.abs(y_ref))), 1e-300)
+                out["compress_rel_err"] = float(
+                    np.max(np.abs(y_c - y_ref)) / scale)
+                out["stream_compress"] = str(tier)
+                out["plan_bytes_encoded"] = int(eng.plan_bytes)
+                out["compress_ratio"] = round(
+                    eng.plan_bytes_raw / max(eng.plan_bytes, 1), 3)
+            _progress(f"{name}: {leg} steady {steady_ms:.2f} ms/apply")
+    finally:
+        cfg.stream_compress = saved_tier
     out["stream_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
         / max(out["streamed_steady_apply_ms"], 1e-9), 2)
+    out["compress_steady_speedup"] = round(
+        out["fused_steady_apply_ms"]
+        / max(out["compressed_steady_apply_ms"], 1e-9), 2)
     obs.emit("bench_result", **out)
     return out
 

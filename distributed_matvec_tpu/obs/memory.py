@@ -88,6 +88,25 @@ _lock = threading.Lock()
 _ledger: Dict[str, dict] = {}           # path -> entry dict (insertion order)
 _instances: Dict[str, int] = {}         # per-kind engine/solver counters
 
+#: Finalizer-safe deferred releases.  Handle.release is the target of the
+#: engines' ``weakref.finalize``, which the garbage collector may run in
+#: the MIDDLE of a ledger operation on the same thread (any allocation
+#: inside a ``with _lock:`` block can trigger a collection) — taking the
+#: non-reentrant ``_lock`` there deadlocks the process (observed: an
+#: engine finalizer firing inside ``ledger_entries``'s snapshot
+#: comprehension froze the whole test suite).  So release never locks: it
+#: queues its paths on this list (``list.append`` is atomic under the
+#: GIL, and the GC never starts a nested collection from a finalizer),
+#: and every locked ledger operation drains the queue first.
+_released: List[List[str]] = []
+
+
+def _drain_released_locked() -> None:
+    """Apply queued finalizer releases; the caller holds ``_lock``."""
+    while _released:
+        for p in _released.pop():
+            _ledger.pop(p, None)
+
 
 @dataclass
 class Handle:
@@ -100,15 +119,16 @@ class Handle:
 
     def set(self, path: str, nbytes: int) -> None:
         with _lock:
+            _drain_released_locked()
             ent = _ledger.get(path)
             if ent is not None:
                 ent["bytes"] = int(nbytes)
 
     def release(self) -> None:
-        with _lock:
-            for p in self.paths:
-                _ledger.pop(p, None)
-        self.paths = []
+        # GC-safe by construction: NO lock here (see ``_released``)
+        paths, self.paths = self.paths, []
+        if paths:
+            _released.append(paths)
 
 
 class _NullHandle(Handle):
@@ -153,6 +173,7 @@ def track(path: str, nbytes: int, device: str = "",
     for k, v in meta.items():
         ent[k] = v
     with _lock:
+        _drain_released_locked()
         _ledger[path] = ent
         if path not in h.paths:
             h.paths.append(path)
@@ -178,6 +199,7 @@ def track_tree(path: str, tree, device: str = "",
 def ledger_entries() -> Dict[str, dict]:
     """Snapshot of the live ledger: {path: {bytes, device, ...meta}}."""
     with _lock:
+        _drain_released_locked()
         return {p: dict(e) for p, e in _ledger.items()}
 
 
@@ -543,6 +565,7 @@ def reset_memory() -> None:
     NEW owner's identically-named paths."""
     global _wm_unsupported, _last_watermark
     with _lock:
+        _drain_released_locked()
         _ledger.clear()
         _exec_analyses.clear()
     with _wm_lock:

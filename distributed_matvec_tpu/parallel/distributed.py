@@ -493,6 +493,20 @@ class DistributedEngine:
                 self._row_provider = row_provider
                 self._stream_build_prog = None
                 self._plan_repaired: dict = {}
+                from ..ops import plan_codec as _PC
+                self._compress = (str(cfg.stream_compress).strip().lower()
+                                  or "off")
+                if self._compress not in _PC.TIERS:
+                    raise ValueError(
+                        f"unknown stream_compress tier "
+                        f"{cfg.stream_compress!r}; pick one of "
+                        f"{'|'.join(_PC.TIERS)}")
+                sk = str(cfg.stream_kernel).strip().lower() or "auto"
+                if sk not in ("auto", "xla", "pallas"):
+                    raise ValueError(
+                        f"unknown stream_kernel {cfg.stream_kernel!r}; "
+                        "pick auto|xla|pallas")
+                self._stream_kernel = "xla" if sk == "auto" else sk
                 stream_cache = self._resolve_structure_cache(structure_cache)
                 self.structure_restored = agree_restored(
                     self._try_load_stream_plan(stream_cache))
@@ -503,6 +517,7 @@ class DistributedEngine:
                             annotate("engine_init/build_plan"):
                         try:
                             self._build_stream_plan(row_provider)
+                            self._encode_stream_plan()
                         except Exception as e:
                             if not obs_memory.is_resource_exhausted(e):
                                 getattr(self, "_plan_stage_h",
@@ -511,6 +526,7 @@ class DistributedEngine:
                                         phase="init",
                                         n_states=int(self.n_states))
                     self._save_stream_plan(stream_cache, soft=soft_save)
+                self._upload_codec_tables()
                 self._register_stream_plan()
                 import weakref
                 weakref.finalize(self, _close_plan_files, self._plan_files)
@@ -1077,10 +1093,15 @@ class DistributedEngine:
             # the plan's dest/exchange layout bakes in the row-chunk size
             # and the per-peer capacity; a knob change must miss, not
             # restore a plan whose scatter targets no longer fit
-            # v2: sidecars carry per-(chunk, shard) CRCs (older v1 files
-            # simply miss and rebuild — no mixed-format reads)
+            # v2: sidecars carry per-(chunk, shard) CRCs
+            # v3: chunks are codec-encoded (ops/plan_codec.py) — the tier
+            # AND the codec format version are part of the identity, so a
+            # knob change or a format bump misses and rebuilds (older v2
+            # files simply miss — no mixed-format reads)
+            from ..ops.plan_codec import PLAN_CODEC_VERSION
             h.update(f"|B{self.batch_size}|cap{self._capacity}"
-                     f"|p{self._lk_probes}|v2".encode())
+                     f"|p{self._lk_probes}|c{self._compress}"
+                     f"|codec{PLAN_CODEC_VERSION}|v3".encode())
         self._fp_cache = h.hexdigest()
         return self._fp_cache
 
@@ -1464,6 +1485,91 @@ class DistributedEngine:
         self._validate_counters(overflow, invalid, "streamed")
         obs_memory.sample_watermark("plan_build/streamed")
 
+    def _codec_ckind(self) -> str:
+        return "real" if self.real else ("pair" if self.pair else "complex")
+
+    def _codec_cshape(self) -> tuple:
+        return (self.batch_size, self.num_terms) \
+            + ((2,) if self.pair else ())
+
+    def _codec_agree(self, use_dict: bool, nd: int, fill: int,
+                     n_live: int):
+        """Job-wide codec decisions for a multi-controller encode: the
+        per-shard dictionaries, the trimmed exchange capacity, and the
+        compacted entry count all enter a collective chunk program as
+        uniformly-shaped operands, so every rank must agree.  Backends
+        without multiprocess host computations degrade to raw
+        uncompacted coefficients everywhere — the same deterministic
+        answer on every rank.  The broad except deliberately mirrors
+        ``agree_restored``'s (PR 5): allgather failures observed in
+        practice are structural (the backend cannot run multiprocess
+        host computations at all) and therefore identical on every
+        rank; a genuinely one-sided transient would already have
+        desynchronized the peers inside the collective itself."""
+        try:
+            from jax.experimental import multihost_utils as mhu
+            g = np.atleast_2d(mhu.process_allgather(np.asarray(
+                [int(bool(use_dict)), int(nd), int(fill), int(n_live)],
+                np.int64)))
+            return (bool(g[:, 0].min()), int(g[:, 1].max()),
+                    int(g[:, 2].max()), int(g[:, 3].max()))
+        except Exception as e:
+            log_debug(f"codec agreement unavailable ({e!r}); raw "
+                      "uncompacted coefficient encoding on all ranks")
+            return (False, 0, int(self._capacity),
+                    self.batch_size * self.num_terms)
+
+    def _encode_stream_plan(self) -> None:
+        """Encode the freshly built raw plan chunks in place
+        (``ops/plan_codec.py``): dead-entry compaction + exchange-
+        capacity trim + bitpacked dest/row/ridx/rok + dictionary or
+        quantized coefficients per the ``stream_compress`` tier (tier
+        "off" still bitpacks ``rok`` — the free lossless win).  From here
+        on the host-RAM copy, the sidecar, and the per-apply H2D stream
+        all carry the encoded bytes; ``plan_bytes_raw`` keeps the
+        uncompressed total for the ratio the trend gate guards."""
+        from ..ops import plan_codec as PC
+
+        D = self.n_devices
+        self._codec = PC.PlanCodec.build(
+            self._compress, self._plan_chunks,
+            n_dest=self.batch_size * self.num_terms,
+            cap_build=self._capacity, n_devices=D,
+            shard_size=self.shard_size,
+            cshape=self._codec_cshape(), ckind=self._codec_ckind(),
+            agree=self._codec_agree if self._multi else None)
+        enc_bytes = 0
+        nrec = 0
+        for per in self._plan_chunks:
+            for d in list(per):
+                per[d] = self._codec.encode_chunk(per[d], d)
+                enc_bytes += PC.PlanCodec.encoded_bytes(per[d])
+                nrec += 1
+        self.plan_bytes_raw = self._codec.raw_chunk_bytes() * nrec
+        self.plan_bytes = enc_bytes
+        log_debug(
+            f"stream plan encoded: tier={self._compress} "
+            f"coeff={self._codec.spec['coeff']} "
+            f"{self.plan_bytes_raw / 1e6:.1f} -> {enc_bytes / 1e6:.1f} MB "
+            f"({self.plan_bytes_raw / max(enc_bytes, 1):.2f}x)")
+
+    def _upload_codec_tables(self) -> None:
+        """Stage the per-shard coefficient dictionaries on the mesh — ONCE
+        per engine, device-resident for its life (they are tiny; only the
+        coded chunk stream re-travels per apply).  Raw/off codecs get an
+        empty [D, 0] placeholder so the chunk program signature is
+        uniform."""
+        D = self.n_devices
+        rows = [None] * D
+        n = 0
+        for d in range(D):
+            if self._shard_addressable(d):
+                rows[d] = self._codec.dict_device_row(d)
+                n += rows[d].nbytes
+        self._cdict_dev = self._assemble_sharded(rows)
+        if n:
+            counter("bytes_h2d", path="plan_codec_dict").inc(n)
+
     def _register_stream_plan(self) -> None:
         """Host-RAM plan bytes into the memory ledger (device="host") for
         the engine's lifetime + one ``plan_stream`` event the capacity
@@ -1481,8 +1587,12 @@ class DistributedEngine:
         weakref.finalize(self, h.release)
         from ..obs import gauge
         gauge("stream_plan_bytes").set(int(self.plan_bytes))
+        raw = int(getattr(self, "plan_bytes_raw", 0) or self.plan_bytes)
         emit("plan_stream", engine="distributed", tier=tier,
              plan_bytes=int(self.plan_bytes),
+             plan_bytes_raw=raw,
+             compress=str(getattr(self, "_compress", "off")),
+             compress_ratio=round(raw / max(int(self.plan_bytes), 1), 4),
              chunks=int(self._plan_nchunks_v),
              capacity=int(self._capacity), batch=int(self.batch_size),
              overflow=int(self._stream_overflow),
@@ -1501,7 +1611,12 @@ class DistributedEngine:
             payload = {"Cap": int(self._capacity), "B": int(self.batch_size),
                        "nchunks": int(self._plan_nchunks_v),
                        "overflow": int(self._stream_overflow),
-                       "invalid": int(self._stream_invalid)}
+                       "invalid": int(self._stream_invalid),
+                       "codec_spec": self._codec.spec_json()}
+            if self._codec.spec["coeff"] == "dict":
+                for d in self._codec.dicts:
+                    if self._shard_addressable(d):
+                        payload[f"cdict_{d}"] = self._codec.dict_store(d)
             for ci, per in enumerate(self._plan_chunks):
                 for d, pc in per.items():
                     # per-(chunk, shard) checksum: the disk tier verifies
@@ -1578,12 +1693,14 @@ class DistributedEngine:
                     for k in ("Cap", "B", "nchunks", "overflow", "invalid"):
                         if k in g.attrs:
                             scalars[k] = int(g.attrs[k])
+                    if "codec_spec" in g.attrs:
+                        scalars["codec_spec"] = str(g.attrs["codec_spec"])
                     for d in my_shards:
                         if d not in where and f"dest_{d}_0" in g:
                             where[d] = cand
             except OSError:
                 continue
-        need = {"Cap", "B", "nchunks", "overflow", "invalid"}
+        need = {"Cap", "B", "nchunks", "overflow", "invalid", "codec_spec"}
         if set(my_shards) - set(where) or need - set(scalars):
             return False
         if scalars["Cap"] != self._capacity \
@@ -1591,6 +1708,18 @@ class DistributedEngine:
             return False      # fingerprinted, but belt-and-braces
         nchunks = scalars["nchunks"]
         if nchunks != self._stream_nchunks():
+            return False
+        from ..ops import plan_codec as PC
+        try:
+            codec = PC.PlanCodec.from_spec_json(scalars["codec_spec"])
+        except (ValueError, KeyError):
+            return False          # future codec format: miss and rebuild
+        if (codec.spec["tier"] != self._compress
+                or codec.spec["n_dest"]
+                != self.batch_size * self.num_terms
+                or codec.spec["cap_build"] != self._capacity
+                or codec.spec["D"] != self.n_devices
+                or codec.spec["ckind"] != self._codec_ckind()):
             return False
         # group shards per candidate so each sidecar opens ONCE for the
         # sizing pass and once for the RAM load — a chain_32-class plan
@@ -1605,6 +1734,8 @@ class DistributedEngine:
                 with h5py.File(cand, "r") as f:
                     g = f["engine_structure"]
                     for d in ds_list:
+                        if codec.spec["coeff"] == "dict":
+                            codec.set_dict(d, g[f"cdict_{d}"][...])
                         for ci in range(nchunks):
                             for k in self._STREAM_ARRAYS:
                                 ds = g[f"{k}_{d}_{ci}"]
@@ -1616,8 +1747,11 @@ class DistributedEngine:
                 from ..utils.artifacts import note_artifact_corrupt
                 note_artifact_corrupt(cand, "stream_plan", e)
                 return False
+        self._codec = codec
         self._plan_nchunks_v = nchunks
         self.plan_bytes = plan_bytes
+        self.plan_bytes_raw = codec.raw_chunk_bytes() \
+            * nchunks * len(my_shards)
         self._stream_overflow = scalars["overflow"]
         self._stream_invalid = scalars["invalid"]
         self._plan_files = {}
@@ -1758,6 +1892,8 @@ class DistributedEngine:
             self._plan_disk = None
             self._plan_repaired.clear()
             self._build_stream_plan(self._row_provider)
+            self._encode_stream_plan()
+            self._upload_codec_tables()
             self._register_stream_plan()
             return self._plan_chunks[ci]
         per = self._rebuild_plan_chunk(ci)
@@ -1767,8 +1903,9 @@ class DistributedEngine:
     def _rebuild_plan_chunk(self, ci: int) -> dict:
         """Re-resolve ONE chunk's plan from structure (tables + per-shard
         lookup are still device-resident in streamed mode) — the same
-        program and row padding as the original build, so the repaired
-        chunk is bit-identical to what the sidecar should have held."""
+        program, row padding, AND codec as the original build, so the
+        repaired chunk's encoded bytes are bit-identical to what the
+        sidecar should have held (the stored CRC would match)."""
         build = self._stream_build_prog
         if build is None:
             build = self._stream_build_prog = self._make_stream_build()
@@ -1782,10 +1919,11 @@ class DistributedEngine:
         dest, cf, ridx, rok, _ov, _iv = build(
             self._assemble_sharded(a_rows), self._assemble_sharded(n_rows),
             self.tables, self._lk_pair, self._lk_dir)
-        per = {d: {"dest": self._shard_piece(dest, d),
-                   "coeff": self._shard_piece(cf, d),
-                   "ridx": self._shard_piece(ridx, d),
-                   "rok": self._shard_piece(rok, d)} for d in my}
+        per = {d: self._codec.encode_chunk(
+            {"dest": self._shard_piece(dest, d),
+             "coeff": self._shard_piece(cf, d),
+             "ridx": self._shard_piece(ridx, d),
+             "rok": self._shard_piece(rok, d)}, d) for d in my}
         emit("plan_chunk_rebuilt", engine="distributed", chunk=int(ci))
         log_debug(f"stream plan chunk {ci} rebuilt from structure")
         return per
@@ -1827,32 +1965,84 @@ class DistributedEngine:
         is_pair = self.pair
         ptail = (2,) if is_pair else ()
         mesh = self.mesh
+        from ..ops import plan_codec as PC
+        spec = self._codec.spec
+        tier_off = spec["tier"] == "off"
+        # the apply runs at the codec's TRIMMED exchange capacity: the
+        # build sized buckets for the worst case, the finished plan knows
+        # the true max fill (cap_eff == cap_build for the off tier)
+        cap_apply = int(spec["cap_eff"])
+        n_recv = D * cap_apply
+        pallas_interp = self.mesh.devices.flat[0].platform != "tpu"
 
         def make_programs(tail):
             nbt = len(tail) - len(ptail)   # number of batch axes (0 or 1)
+            # the explicit Pallas kernel covers the dict-coded real-sector
+            # single-column stream (the bench/gate shape); every other
+            # shape decodes through the XLA-ops path, which the compiler
+            # fuses into the chunk program anyway
+            use_pallas = (self._stream_kernel == "pallas"
+                          and not tier_off
+                          and spec["coeff"] == "dict"
+                          and self.real and tail == ())
 
-            def shard_body(xp, y, start, dest, coeff, ridx, rok):
+            def shard_body(xp, y, start, dest, coeff, ridx, rok, cdict):
                 xp_, y_ = xp[0], y[0]
-                dest_, cf_ = dest[0], coeff[0]
-                ridx_, rok_ = ridx[0], rok[0]
                 zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
                 x_c = jax.lax.dynamic_slice(
                     xp_, (start,) + zeros, (B,) + tail)
-                # identical arithmetic to the fused chunk: amplitudes are
-                # conj-coefficient × x, dead/overflowed entries dropped by
-                # dest == D·Cap (coeff is pre-zeroed for dead entries)
-                x_t = x_c[:, None]
-                g_t = cf_
-                if nbt:
-                    g_t = g_t[:, :, None, :] if is_pair else g_t[:, :, None]
-                amps = K.cmul_pair(g_t, x_t) if is_pair else g_t * x_t
-                flat_a = amps.reshape((-1,) + tail)
-                send_a = jnp.zeros((D * Cap,) + tail, dtype).at[dest_].set(
-                    flat_a, mode="drop")
+                if use_pallas:
+                    # fused decode+gather+multiply+scatter in one kernel;
+                    # same arithmetic, so the result is bit-identical to
+                    # the XLA decode path
+                    ridx_ = PC.unpack_bits(
+                        ridx[0], n_recv, spec["w_ridx"]).astype(jnp.int32)
+                    rok_ = PC.unpack_bits(rok[0], n_recv, 1).astype(bool)
+                    send_a = PC.fused_decode_gather_scatter(
+                        spec, dest[0], coeff[0], cdict[0], x_c,
+                        interpret=pallas_interp)[:n_recv]
+                elif tier_off:
+                    # raw plan layout: identical arithmetic to the fused
+                    # chunk — amplitudes are conj-coefficient × x,
+                    # dead/overflowed entries dropped by dest == D·Cap
+                    # (coeff is pre-zeroed for dead entries)
+                    dest_, cf_, ridx_, rok_ = PC.decode_plan_shard(
+                        spec, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                    x_t = x_c[:, None]
+                    g_t = cf_
+                    if nbt:
+                        g_t = g_t[:, :, None, :] if is_pair \
+                            else g_t[:, :, None]
+                    amps = K.cmul_pair(g_t, x_t) if is_pair else g_t * x_t
+                    flat_a = amps.reshape((-1,) + tail)
+                    send_a = jnp.zeros((n_recv,) + tail,
+                                       dtype).at[dest_].set(
+                        flat_a, mode="drop")
+                else:
+                    # compacted stream, decoded in-program: XLA fuses the
+                    # unpack/dict gathers with the explicit row gather,
+                    # multiply and scatter below — the "fused decode"
+                    # default.  Only LIVE entries exist (dead ones never
+                    # left the host), the explicit x[row] gather replaces
+                    # the implicit i // T, and padding entries scatter to
+                    # the drop sentinel.  Values and accumulation order
+                    # match the raw layout exactly (DESIGN.md §23).
+                    dest_, row_, cf_, ridx_, rok_ = PC.decode_plan_shard(
+                        spec, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                    xg = x_c[row_]                     # [n_live] + tail
+                    if is_pair:
+                        g = cf_[:, None, :] if nbt else cf_
+                        amps = K.cmul_pair(g, xg)
+                    else:
+                        g = cf_[:, None] if nbt else cf_
+                        amps = g * xg
+                    send_a = jnp.zeros((n_recv,) + tail,
+                                       dtype).at[dest_].set(
+                        amps, mode="drop")
                 if D > 1:
                     recv_a = jax.lax.all_to_all(
-                        send_a.reshape((D, Cap) + tail), SHARD_AXIS, 0, 0,
-                        tiled=True
+                        send_a.reshape((D, cap_apply) + tail), SHARD_AXIS,
+                        0, 0, tiled=True
                     ).reshape((-1,) + tail)
                 else:
                     recv_a = send_a
@@ -1863,16 +2053,17 @@ class DistributedEngine:
                 return y_[None]
 
             nd = 2 + len(tail)
-            cf_nd = 3 + len(ptail)
 
-            def chunk_fn(xp, y, start, dest, coeff, ridx, rok):
+            def chunk_fn(xp, y, start, dest, coeff, ridx, rok, cdict):
                 f = shard_map_compat(
                     shard_body, mesh=mesh,
-                    in_specs=(_pspec(nd), _pspec(nd), P(), _pspec(2),
-                              _pspec(cf_nd), _pspec(2), _pspec(2)),
+                    in_specs=(_pspec(nd), _pspec(nd), P(),
+                              _pspec(dest.ndim), _pspec(coeff.ndim),
+                              _pspec(ridx.ndim), _pspec(rok.ndim),
+                              _pspec(cdict.ndim)),
                     out_specs=_pspec(nd),
                 )
-                return f(xp, y, start, dest, coeff, ridx, rok)
+                return f(xp, y, start, dest, coeff, ridx, rok, cdict)
 
             chunk_prog = jax.jit(chunk_fn, donate_argnums=(1,))
             pad_prog = jax.jit(lambda x: jnp.pad(
@@ -1918,7 +2109,8 @@ class DistributedEngine:
                     histogram("plan_stream_stall_ms").observe(stall_ms)
                     entry["stall_ms"] = round(stall_ms, 4)
                 _td = time.perf_counter()
-                y = chunk_prog(xp, y, jnp.int32(ci * B), *pending)
+                y = chunk_prog(xp, y, jnp.int32(ci * B), *pending,
+                               self._cdict_dev)
                 if timeline is not None:
                     entry["dispatch_ms"] = round(
                         (time.perf_counter() - _td) * 1e3, 4)
@@ -2584,11 +2776,21 @@ class DistributedEngine:
             Cap = self._last_capacity or self._capacity
             B = self.batch_size if self.mode == "streamed" \
                 else int(self._last_program_key or self.batch_size)
-            seg = nmy * nch * D * Cap
+            if self.mode == "streamed":
+                # the codec sets the apply's real geometry: trimmed
+                # exchange capacity, and (compressed tiers) live entries
+                # only — the structural counts must match the work the
+                # chunk program actually dispatches
+                spec = self._codec.spec
+                seg = nmy * nch * int(spec["n_recv"])
+            else:
+                seg = nmy * nch * D * Cap
             c["accumulate"] = {"bytes": seg * vb * k, "gathers": seg,
                                "flops": seg * k * (2 if cplx else 1)}
             ent = nmy * nch * B * T
             if self.mode == "streamed":
+                if spec["tier"] != "off":
+                    ent = nmy * nch * int(spec["n_live"])
                 ngroups = -(-k // 4) if k > 4 else 1
                 c["plan_h2d"]["bytes"] = int(self.plan_bytes) * ngroups
                 c["compute"] = {"bytes": ent * vb * k, "gathers": 0,
@@ -2624,9 +2826,11 @@ class DistributedEngine:
         if self.mode == "streamed":
             # amplitudes only: the receive side already holds its layout,
             # so the betas no longer travel (half the fused exchange for
-            # real sectors)
+            # real sectors) — at the codec's TRIMMED capacity (== the
+            # build capacity for the off tier)
             item = int(jnp.dtype(self._dtype).itemsize)
-            return (nmy * self._plan_nchunks_v * D * self._capacity
+            cap = int(self._codec.spec["cap_eff"])
+            return (nmy * self._plan_nchunks_v * D * cap
                     * tail_elems * item)
         cap = (self._last_capacity if self._last_capacity is not None
                else getattr(self, "_capacity", 0))

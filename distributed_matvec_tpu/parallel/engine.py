@@ -412,9 +412,14 @@ def register_engine_memory(eng, engine_kind: str) -> None:
     elif getattr(eng, "_capacity", None) is not None:
         ctx["exchange_capacity"] = int(eng._capacity)
     if getattr(eng, "plan_bytes", None) is not None:
-        # streamed engines: host-RAM plan size, so the capacity planner
-        # can size the streamed tier from the snapshot alone
+        # streamed engines: host-RAM plan size (ENCODED bytes once the
+        # codec ran), so the capacity planner can size the streamed tier
+        # from the snapshot alone; the raw total + tier let it calibrate
+        # the other stream_compress settings too
         ctx["plan_bytes"] = int(eng.plan_bytes)
+        ctx["stream_compress"] = str(getattr(eng, "_compress", "off"))
+        if getattr(eng, "plan_bytes_raw", None):
+            ctx["plan_bytes_raw"] = int(eng.plan_bytes_raw)
     obs_memory.emit_ledger(f"engine_init/{engine_kind}", **ctx)
     obs_memory.sample_watermark(f"engine_init/{engine_kind}")
 

@@ -77,6 +77,21 @@ class RuntimeConfig:
     #   artifact-cache sidecar (disk tier) and chunks are read back per
     #   apply — with the artifact layer off the plan stays in RAM with a
     #   warning (pure host-RAM streaming never writes disk)
+    stream_compress: str = "off"           # streamed-plan codec tier
+    #   (DMT_STREAM_COMPRESS, ops/plan_codec.py): "off" (raw arrays, rok
+    #   still bitpacked — bit-identical to fused), "lossless" (bitpacked
+    #   indices + f64 dictionary coefficients; decoded values are exact,
+    #   gated by the measured-error gate), "f32"/"bf16" (quantized
+    #   coefficients, f64 accumulation — for operators whose coefficients
+    #   don't repeat enough to dictionary-code).  The plan sidecar, the
+    #   host-RAM copy, and the per-apply H2D stream all carry the ENCODED
+    #   bytes; decode happens on device inside the chunk program
+    stream_kernel: str = "auto"            # compressed-chunk decode path
+    #   (DMT_STREAM_KERNEL): "auto" (currently = xla), "xla" (decode ops
+    #   traced into the chunk program — XLA fuses unpack+gather+multiply+
+    #   segment-add), "pallas" (the explicit fused decode+gather+multiply+
+    #   scatter kernel, interpret mode on non-TPU backends; real-sector
+    #   single-column dict-coded chunks only, others fall back to xla)
     split_gather: str = "auto"             # triple-f32 gathers: auto | on | off
     #   (auto = on for the TPU backend; see ops/split_gather.py)
     term_loop: str = "auto"                # ELL/compact per-term loop form:

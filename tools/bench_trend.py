@@ -55,11 +55,16 @@ METRIC_WHITELIST = (
     "fused_steady_apply_ms", "streamed_steady_apply_ms",
     "stream_steady_speedup", "plan_bytes", "plan_build_s",
     "plan_stream_stall_ms", "apply_wall_ms", "speedup_vs_numpy",
+    "plan_bytes_encoded", "compress_ratio", "compressed_steady_apply_ms",
+    "compress_steady_speedup", "compress_rel_err",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
-#: ``obs_report diff``).
+#: ``obs_report diff``).  ``compress_ratio`` guards the plan codec: a PR
+#: that quietly gives back the encoded-bytes win fails the gate even if
+#: wall clocks hold.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
+                "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s")
 
 

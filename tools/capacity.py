@@ -120,13 +120,41 @@ def mode_bytes_per_row(T0: int, pair: bool) -> Dict[str, float]:
             "fused": 0.0}
 
 
-def stream_plan_bytes_per_row(num_terms: int, pair: bool) -> float:
+#: stream_compress settings the planner models (ops/plan_codec.py tiers).
+STREAM_COMPRESS_SETTINGS = ("off", "lossless", "f32", "bf16")
+
+#: Live-entry share of a compacted plan (the codec stores only entries
+#: whose coefficient is nonzero): measured ~52% live on Heisenberg
+#: chains.  A documented model constant — measured calibration wins.
+LIVE_FRACTION = 0.55
+
+
+def stream_plan_bytes_per_row(num_terms: int, pair: bool,
+                              compress: str = "off") -> float:
     """HOST bytes per basis row of a streamed engine's resolved plan:
-    dest i32 + coefficient per (row, term); the per-chunk receive layout
-    (ridx + rok per exchange slot) adds a few percent and is folded into
-    a flat 10% overhead rather than modeled exactly."""
+    dest index + coefficient per (row, term); the per-chunk receive
+    layout (ridx + rok per exchange slot) adds a few percent and is
+    folded into a flat overhead rather than modeled exactly.
+
+    Compressed settings (``ops/plan_codec.py``): only LIVE entries are
+    stored (``LIVE_FRACTION`` models the Heisenberg-class dead share —
+    measured 48% dead on chain_24_symm; operators where every term fires
+    on every row should read the measured calibration instead),
+    destination+row indices bitpack to ~4 B/live entry, and the
+    receive-layout overhead drops 10% → 8% (capacity trimmed, ridx
+    packed, rok 1 bit).  Coefficients: ``lossless`` assumes u16
+    dictionary codes (symm-sector coefficients repeat; a dict overflow
+    falls back to raw f64 and the measured calibration then wins);
+    ``f32``/``bf16`` are modeled in their raw-quantized form — the
+    tiers exist for operators whose coefficients do NOT repeat enough
+    to dictionary-code."""
     cf = 16 if pair else 8
-    return num_terms * (4 + cf) * 1.10
+    if compress in (None, "", "off"):
+        return num_terms * (4 + cf) * 1.10
+    ncomp = 2 if pair else 1
+    coeff_b = {"lossless": 2.0, "f32": 4.0 * ncomp,
+               "bf16": 2.0 * ncomp}[compress]
+    return num_terms * (4.0 + coeff_b) * LIVE_FRACTION * 1.08
 
 
 def load_rate_calibration(path: Optional[str] = None) -> Optional[dict]:
@@ -155,17 +183,26 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
          measured: Optional[dict] = None,
          utilization: float = DEFAULT_UTILIZATION,
          host_ram_gb: float = 64.0,
-         rates: Optional[dict] = None) -> dict:
+         rates: Optional[dict] = None,
+         stream_compress: str = "off") -> dict:
     """The capacity report: bytes/row, max basis per device and per mesh
     for each mode, plus (optionally) measured calibration.  The streamed
     mode is additionally bounded by HOST RAM (``host_ram_gb``, per rank —
     one rank per device assumed): its resolved plan streams from there,
-    so the binding constraint is min(device rows, host plan rows).  With a
-    ``rates`` calibration (gather_bound sidecar) each mode also gets an
-    ``est_apply_ms`` gather/stream-bound apply-time estimate."""
+    so the binding constraint is min(device rows, host plan rows) — and
+    the plan is the ENCODED stream at the chosen ``stream_compress``
+    setting (every setting's bytes/row rides along in
+    ``host_plan_bytes_per_row_by_compress``).  With a ``rates``
+    calibration (gather_bound sidecar) each mode also gets an
+    ``est_apply_ms`` gather/stream-bound apply-time estimate; the
+    streamed estimate prices the *encoded* H2D bytes, so compression
+    shows up directly in the est ms/apply column."""
     T0 = int(T0) if T0 else int(num_terms)
+    if stream_compress not in STREAM_COMPRESS_SETTINGS:
+        raise ValueError(f"unknown stream_compress {stream_compress!r}")
     per_mode = mode_bytes_per_row(T0, pair)
-    plan_row = stream_plan_bytes_per_row(int(num_terms), pair)
+    plan_row_by = {s: stream_plan_bytes_per_row(int(num_terms), pair, s)
+                   for s in STREAM_COMPRESS_SETTINGS}
     vec_bytes = 8 * vectors * max(vec_width, 1) * (2 if pair else 1)
     common = COMMON_ROW_BYTES + vec_bytes
     budget = hbm_gb * 1e9 * utilization
@@ -174,7 +211,8 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
                       "T0": T0, "pair": bool(pair), "hbm_gb": hbm_gb,
                       "host_ram_gb": host_ram_gb,
                       "n_devices": int(n_devices), "vectors": vectors,
-                      "vec_width": vec_width, "utilization": utilization},
+                      "vec_width": vec_width, "utilization": utilization,
+                      "stream_compress": stream_compress},
            "modes": {}}
     if measured:
         out["calibration"] = measured
@@ -185,10 +223,29 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
             out["calibration"] = dict(
                 measured, bytes_per_row_measured=round(per_mode[mmode], 2))
         if mmode == "streamed" and measured.get("plan_bytes") and n_pad:
-            plan_row = measured["plan_bytes"] / float(n_pad)
+            # the ledger's plan_bytes are the ENCODED bytes at the
+            # recorded stream_compress setting; anchor that setting on
+            # the measurement (and "off" on plan_bytes_raw when present),
+            # then scale the un-measured settings by the model's ratios
+            mcomp = str(measured.get("stream_compress") or "off")
+            if mcomp not in plan_row_by:
+                mcomp = "off"
+            model = dict(plan_row_by)      # pre-anchor model ratios
+            anchor_row = measured["plan_bytes"] / float(n_pad)
+            raw_row = (measured["plan_bytes_raw"] / float(n_pad)
+                       if measured.get("plan_bytes_raw") else None)
+            for s in STREAM_COMPRESS_SETTINGS:
+                if s == mcomp:
+                    plan_row_by[s] = anchor_row
+                elif s == "off" and raw_row is not None:
+                    plan_row_by[s] = raw_row
+                else:
+                    plan_row_by[s] = anchor_row * model[s] / model[mcomp]
             out["calibration"] = dict(
                 out["calibration"],
-                plan_bytes_per_row_measured=round(plan_row, 2))
+                plan_bytes_per_row_measured=round(anchor_row, 2),
+                plan_bytes_per_row_compress=mcomp)
+    plan_row = plan_row_by[stream_compress]
     if rates:
         out["rates"] = {k: rates.get(k) for k in
                         ("gather_rows_per_s", "h2d_bytes_per_s",
@@ -203,6 +260,9 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
         }
         if mode == "streamed":
             entry["host_plan_bytes_per_row"] = round(plan_row, 2)
+            entry["stream_compress"] = stream_compress
+            entry["host_plan_bytes_per_row_by_compress"] = {
+                s: round(r, 2) for s, r in plan_row_by.items()}
             rows_dev = min(rows_dev, int(host_budget // plan_row))
         if rates and rates.get("gather_rows_per_s"):
             # gather-roofline apply-time estimate per device shard at the
@@ -287,7 +347,8 @@ def print_report(report: dict, rec: dict) -> None:
           + (f" {'est ms/apply':>13}" if est_col else "") + "  fits N?")
     for mode in ("ell", "compact", "streamed", "fused"):
         m = report["modes"][mode]
-        note = (f"  (+{m['host_plan_bytes_per_row']:.0f} B/row host plan)"
+        note = (f"  (+{m['host_plan_bytes_per_row']:.0f} B/row host plan, "
+                f"stream_compress={m['stream_compress']})"
                 if "host_plan_bytes_per_row" in m else "")
         est = (f" {m['est_apply_ms']:>13,.1f}" if "est_apply_ms" in m
                else (" " * 14 if est_col else ""))
@@ -296,6 +357,10 @@ def print_report(report: dict, rec: dict) -> None:
               f"{m['max_rows_per_device']:>16,} "
               f"{m['max_basis_size']:>17,} {est} "
               f"{'yes' if m['fits_n_states'] else 'no'}{note}")
+        if "host_plan_bytes_per_row_by_compress" in m:
+            by = m["host_plan_bytes_per_row_by_compress"]
+            print("            host plan B/row by stream_compress: "
+                  + "  ".join(f"{s}={by[s]:.0f}" for s in by))
     print(f"  recommendation: {rec['note']}")
 
 
@@ -329,6 +394,13 @@ def main(argv=None) -> int:
                     help="RHS columns per vector (multi-RHS batches)")
     ap.add_argument("--target-n", type=float, default=None,
                     help="recommend mode/shards for this basis size")
+    ap.add_argument("--stream-compress",
+                    choices=STREAM_COMPRESS_SETTINGS,
+                    default=os.environ.get("DMT_STREAM_COMPRESS", "off"),
+                    help="streamed-plan codec setting to size the host "
+                         "plan (and its est ms/apply) at; every "
+                         "setting's bytes/row is reported alongside "
+                         "(default: DMT_STREAM_COMPRESS or off)")
     ap.add_argument("--calibration", default=None, metavar="PATH",
                     help="rate-calibration JSON from tools/gather_bound.py "
                          "(default: the content-addressed sidecar under "
@@ -344,13 +416,15 @@ def main(argv=None) -> int:
         measured = {k: led.get(k) for k in
                     ("mode", "n_states", "n_padded", "shard_size",
                      "n_devices", "T0", "table_bytes", "num_terms", "pair",
-                     "plan_bytes")}
-        if measured.get("plan_bytes"):
-            # a rank's ledger reports its OWN shards' plan bytes; the
-            # per-row calibration divides by the GLOBAL padded row count,
-            # so scale to the whole job (event envelopes carry n_ranks)
-            measured["plan_bytes"] = int(measured["plan_bytes"]) \
-                * int(led.get("n_ranks", 1) or 1)
+                     "plan_bytes", "plan_bytes_raw", "stream_compress")}
+        for key in ("plan_bytes", "plan_bytes_raw"):
+            if measured.get(key):
+                # a rank's ledger reports its OWN shards' plan bytes; the
+                # per-row calibration divides by the GLOBAL padded row
+                # count, so scale to the whole job (envelopes carry
+                # n_ranks)
+                measured[key] = int(measured[key]) \
+                    * int(led.get("n_ranks", 1) or 1)
         if measured.get("n_padded") is None and led.get("shard_size"):
             measured["n_padded"] = int(led["shard_size"]) \
                 * int(led.get("n_devices", 1))
@@ -382,7 +456,8 @@ def main(argv=None) -> int:
                   args.vectors, args.vec_width, measured=measured,
                   utilization=args.utilization,
                   host_ram_gb=args.host_ram_gb,
-                  rates=load_rate_calibration(args.calibration))
+                  rates=load_rate_calibration(args.calibration),
+                  stream_compress=args.stream_compress)
     rec = recommend(report, int(args.target_n) if args.target_n else None)
     if args.json:
         print(json.dumps({"report": report, "recommendation": rec},

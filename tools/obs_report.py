@@ -92,7 +92,8 @@ from typing import Dict, List, Optional
 # Metrics where a LOWER value in the new run is the regression (rates,
 # speedups); everything else numeric is treated as cost-like (ms, seconds,
 # bytes, iteration counts) where HIGHER is the regression.
-_HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate")
+_HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate",
+                     "compress_ratio")
 
 _DEFAULT_GATE = ("device_ms",)
 
