@@ -13,7 +13,7 @@ HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
-	roofline-check compress-check clean
+	roofline-check compress-check trace-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -23,6 +23,7 @@ check:
 	$(MAKE) stream-check
 	$(MAKE) compress-check
 	$(MAKE) roofline-check
+	$(MAKE) trace-check
 	$(MAKE) fault-check
 
 check-fast:
@@ -110,6 +111,16 @@ compress-check:
 # synthetic 10x regression.  Deterministic, ~30 s on the CPU rig.
 roofline-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/roofline_check.py
+
+# Tracing gate (tools/trace_check.py): apply HLO byte-identity with
+# tracing on vs off (local ell; streamed result bit-identity rides
+# along), DMT_OBS=off emits zero spans (provable no-op), a REAL 2-rank
+# recorded run agrees on one trace id and exports a Perfetto JSON with
+# balanced B/E pairs nesting chunk < apply < iteration < solve on both
+# rank tracks, and `obs_report watch --once` renders a dashboard frame
+# from it.  Deterministic, ~60 s on the CPU rig.
+trace-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/trace_check.py
 
 # Chaos gate (tools/fault_check.py): the ROADMAP's resumed-run
 # bit-consistency acceptance as a repeatable gate — kill a 2-device solve

@@ -34,6 +34,18 @@ enable_compilation_cache()
 
 
 def main(argv=None):
+    # root run span: every event of the run (engine builds, solver
+    # iterations, applies, the save epilogue) becomes a descendant of one
+    # `diagonalize` span, and the trace-id stamp resolves lazily AFTER
+    # _main() points obs at the run directory — the span event itself is
+    # written by the line-buffered sink + atexit flush backstop
+    from distributed_matvec_tpu.obs import trace as _trace
+
+    with _trace.span("diagonalize", kind="run"):
+        return _main(argv)
+
+
+def _main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("input", help="YAML config (data/*.yaml schema)")
     ap.add_argument("-o", "--output", default=None,
@@ -105,6 +117,12 @@ def main(argv=None):
                          "phase timings stream to DIR/rank_<r>/events.jsonl "
                          "for tools/obs_report.py (merge / report --ranks "
                          "for multi-rank runs)")
+    ap.add_argument("--job-id", default=None, metavar="ID",
+                    help="job-namespacing id stamped into every telemetry "
+                         "event (DMT_JOB_ID; default: the run's trace id) "
+                         "— lets a scheduler multiplexing many concurrent "
+                         "solves filter one job's events/spans out of a "
+                         "shared stream (obs_report watch/trace read it)")
     ap.add_argument("--health", choices=("on", "strict", "off"),
                     default=None,
                     help="numerical-health watchdog (DMT_HEALTH): on = "
@@ -126,6 +144,12 @@ def main(argv=None):
 
     if args.obs_dir:
         update_config(obs_dir=args.obs_dir)
+    if args.job_id:
+        # env AND config, same both-or-neither contract as --health: an
+        # inherited DMT_JOB_ID must not outrank the id requested on the
+        # command line, and child processes must inherit it
+        os.environ["DMT_JOB_ID"] = args.job_id
+        update_config(job_id=args.job_id)
     if args.health:
         # the env var outranks the config field (per-subprocess override
         # contract), so the CLI must set BOTH or an inherited DMT_HEALTH

@@ -56,9 +56,17 @@ def _default_cache_dir():
     return "/tmp/dmt_bench_cache"
 
 
-def _bench_config(name, basis_args, repeats=20, host_repeats=3,
-                  solver_iters=0, host_sample_rows=None, edges=None,
-                  cache_dir=None):
+def _bench_config(name, *args, **kwargs):
+    # per-config span: everything the config does (basis build, engine
+    # init, applies, the Lanczos probe) nests under one `config` span of
+    # the bench run's root span
+    with obs.span(f"bench:{name}", kind="config", config=name):
+        return _bench_config_impl(name, *args, **kwargs)
+
+
+def _bench_config_impl(name, basis_args, repeats=20, host_repeats=3,
+                       solver_iters=0, host_sample_rows=None, edges=None,
+                       cache_dir=None):
     import jax
 
     from distributed_matvec_tpu.io import make_or_restore_representatives
@@ -277,8 +285,13 @@ def _bench_config(name, basis_args, repeats=20, host_repeats=3,
     return out
 
 
-def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1,
-                  compress_tier="lossless"):
+def _bench_stream(name, *args, **kwargs):
+    with obs.span(f"bench:{name}", kind="config", config=name):
+        return _bench_stream_impl(name, *args, **kwargs)
+
+
+def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
+                       compress_tier="lossless"):
     """Fused vs streamed vs compressed-streamed DistributedEngine on one
     config.
 
@@ -373,6 +386,15 @@ def _bench_stream(name, basis_args, repeats=5, edges=None, n_devices=1,
                 out["plan_bytes_encoded"] = int(eng.plan_bytes)
                 out["compress_ratio"] = round(
                     eng.plan_bytes_raw / max(eng.plan_bytes, 1), 3)
+                # lossy-tier drift series (probe-cadence compress_drift
+                # events; empty for the lossless tier): the worst
+                # input-weighted coefficient error seen across this leg's
+                # applies — trend-gated so accumulation regressions fire
+                obs.drain_health()
+                drift = [e["rel_err"]
+                         for e in obs.events("compress_drift")]
+                if drift:
+                    out["compress_drift_max"] = float(max(drift))
             _progress(f"{name}: {leg} steady {steady_ms:.2f} ms/apply")
     finally:
         cfg.stream_compress = saved_tier
@@ -429,6 +451,14 @@ def _probe_device(timeout_s: int = 180) -> bool:
 
 
 def main():
+    # root run span: the whole bench (every config span, engine event,
+    # trend append) under one `bench` span — opened before any telemetry
+    # so the first event already carries the trace identity
+    with obs.span("bench", kind="run"):
+        return _main()
+
+
+def _main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small CPU-safe run")
     ap.add_argument("--no-probe", action="store_true",
@@ -449,7 +479,13 @@ def main():
                     help="where to append the compact bench-trend record "
                          "(default: PROGRESS.jsonl next to this script; "
                          "'none' disables — see tools/bench_trend.py)")
+    ap.add_argument("--job-id", default=None, metavar="ID",
+                    help="job-namespacing id stamped into every telemetry "
+                         "event and the bench-trend record (DMT_JOB_ID; "
+                         "default: the run's trace id)")
     args = ap.parse_args()
+    if args.job_id:
+        os.environ["DMT_JOB_ID"] = args.job_id
     global _PROFILE_DIR
     _PROFILE_DIR = args.profile_dir
 
@@ -469,6 +505,8 @@ def main():
             argv += ["--profile-dir", args.profile_dir]
         if args.trend_out:
             argv += ["--trend-out", args.trend_out]
+        if args.job_id:
+            argv += ["--job-id", args.job_id]
         os.execve(sys.executable, argv, env)
 
     if args.smoke or args.cpu_fallback:
@@ -625,7 +663,11 @@ def main():
                     else "cpu_fallback" if args.cpu_fallback else "full")
             rec = bench_trend.compact_record(
                 {"main": main_cfg, **detail}, mode=mode,
-                backend=jax.default_backend())
+                backend=jax.default_backend(),
+                # run identity: a gated regression in this record greps
+                # straight back to its run directory / Perfetto trace
+                trace_id=obs.trace_id(), job_id=obs.job_id(),
+                obs_dir=obs.run_dir())
             trend_path = args.trend_out or bench_trend.default_progress_path()
             if rec["configs"] and bench_trend.append_record(trend_path, rec):
                 line["trend_file"] = os.path.basename(trend_path)

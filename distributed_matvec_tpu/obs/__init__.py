@@ -29,10 +29,17 @@ visibility GSPMD (arXiv:2105.04663) treats as a first-class signal):
   on or off) and the analytical roofline model over them (calibrated
   rates, binding-resource naming, pipelined-apply speedup estimates) —
   DESIGN.md §22.
+* :mod:`~.trace` — end-to-end solve tracing (DESIGN.md §24): one
+  ``trace_id`` per run (file-agreed across ranks through the shared run
+  directory), a ``job_id`` namespacing knob (``DMT_JOB_ID``), and
+  parent-linked spans (solve > iteration > apply > chunk) stamped into
+  every event's envelope; one ``span`` event per closed span.
 * ``tools/obs_report.py`` — the reader: ``summarize`` one run, ``merge`` /
   ``report --ranks`` a multi-rank one (skew-corrected timeline, per-rank
   straggler attribution), ``diff`` two runs as a CI perf gate,
-  ``roofline`` the phase/cost-model report, ``tail`` a live one.
+  ``roofline`` the phase/cost-model report, ``trace`` a Perfetto export
+  of the merged span tree, ``watch`` a live terminal dashboard over the
+  rank streams, ``tail`` a live one.
 
 Config: ``DMT_OBS_DIR`` (or ``obs_dir``) points the sink at a run
 directory; unset ⇒ in-memory only; ``DMT_OBS=off`` disables the layer
@@ -55,6 +62,8 @@ from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
                       reset_metrics, series_name)
 from .metrics import snapshot as _metrics_snapshot
 from .phases import (PHASES, emit_apply_phases, phases_enabled, zero_counts)
+from .trace import (current_span_id, deepest_span, job_id, open_spans,
+                    reset_trace, span, span_path, trace_enabled, trace_id)
 
 __all__ = [
     "annotate",
@@ -100,6 +109,15 @@ __all__ = [
     "emit_apply_phases",
     "phases_enabled",
     "zero_counts",
+    "current_span_id",
+    "deepest_span",
+    "job_id",
+    "open_spans",
+    "reset_trace",
+    "span",
+    "span_path",
+    "trace_enabled",
+    "trace_id",
 ]
 
 
@@ -112,9 +130,10 @@ def snapshot() -> dict:
 
 
 def reset_all() -> None:
-    """Reset events, metrics, health AND memory state (test isolation
-    helper)."""
+    """Reset events, metrics, health, memory AND trace state (test
+    isolation helper)."""
     reset()
     reset_metrics()
     reset_health()
     reset_memory()
+    reset_trace()

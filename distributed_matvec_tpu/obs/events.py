@@ -4,12 +4,17 @@ One pillar of the telemetry subsystem (see ``obs/__init__``).  Every event is
 a flat JSON object with a fixed envelope::
 
     {"seq": 17, "ts": 1754092800.123456, "proc": 0, "rank": 0, "n_ranks": 2,
-     "kind": "engine_init", ...payload fields...}
+     "kind": "engine_init", "trace_id": "9f2c...", "job_id": "9f2c...",
+     "span_id": "3-a1b2", ...payload fields...}
 
 ``seq`` is a per-process monotonic sequence number (readers order one rank's
 stream by ``seq`` — wall clocks across hosts are not trusted), ``rank`` the
 JAX process index and ``n_ranks`` the process count (``proc`` is kept as a
-``rank`` alias for pre-rank readers).  With ``DMT_OBS_DIR`` (or
+``rank`` alias for pre-rank readers).  When the tracing layer is on
+(``obs/trace.py``, default) the envelope also carries the run's
+``trace_id``, the ``job_id`` namespacing knob, and the ``span_id`` of the
+innermost open span — readers treat all three as optional (pre-trace
+streams simply lack them).  With ``DMT_OBS_DIR`` (or
 ``config.obs_dir``) set, each process appends to its OWN file
 ``<dir>/rank_<r>/events.jsonl`` — multi-host safe by construction, no
 cross-process file locking — and every event is
@@ -51,6 +56,7 @@ __all__ = [
     "annotate",
     "flush",
     "reset",
+    "set_trace_stamper",
 ]
 
 _BUFFER_CAP = 1 << 16
@@ -62,6 +68,18 @@ _sink = None                 # open file object, or None
 _sink_path: Optional[str] = None
 _sink_failed = False
 _atexit_registered = False
+_trace_stamper = None        # obs/trace.py registers its envelope stamper
+
+
+def set_trace_stamper(fn) -> None:
+    """Register the tracing layer's envelope stamper (``obs/trace.py``
+    calls this at import).  ``fn()`` returns the ``trace_id``/``job_id``/
+    ``span_id`` fields :func:`emit` merges into every event's envelope —
+    a callback instead of an import so this sink stays standalone and
+    cycle-free.  A failing stamper is dropped for the process: causality
+    stamps must never cost the event itself."""
+    global _trace_stamper
+    _trace_stamper = fn
 
 
 def obs_enabled() -> bool:
@@ -147,9 +165,18 @@ def emit(kind: str, **fields) -> Optional[dict]:
     with one is DROPPED — readers key cross-rank ordering and straggler
     attribution on the envelope, so a producer must never be able to
     spoof it."""
-    global _seq
+    global _seq, _trace_stamper
     if not obs_enabled():
         return None
+    stamp = None
+    if _trace_stamper is not None:
+        # outside _lock: the stamper takes the trace layer's own lock and
+        # may touch the run directory once (trace-id agreement)
+        try:
+            stamp = _trace_stamper()
+        except Exception as e:
+            log_warn(f"trace stamper disabled: {e!r}")
+            _trace_stamper = None
     with _lock:
         seq = _seq
         _seq += 1
@@ -157,6 +184,10 @@ def emit(kind: str, **fields) -> Optional[dict]:
         ev = {"seq": seq, "ts": round(time.time(), 6),
               "proc": rank, "rank": rank, "n_ranks": _process_count(),
               "kind": str(kind)}
+        if stamp:
+            # trace_id / job_id / span_id join the envelope: causal
+            # identity is envelope truth, so a producer cannot spoof it
+            ev.update(stamp)
         for k, v in fields.items():
             if k not in ev:
                 ev[k] = v

@@ -55,6 +55,7 @@ __all__ = [
     "probe_due",
     "probe_apply",
     "defer_exchange_counters",
+    "defer_compress_drift",
     "drain",
     "record",
     "omega_estimate",
@@ -169,6 +170,28 @@ def defer_exchange_counters(engine: str, apply_index: int,
     _pending.append(item)
 
 
+def defer_compress_drift(engine: str, apply_index: int, tier: str,
+                         chunk: int, num, den) -> None:
+    """Queue one lossy-tier numerical-drift sample (streamed engines with
+    ``stream_compress=f32|bf16``, probe-cadence applies only): ``num`` /
+    ``den`` are device scalars ‖Δc·x[rows]‖ / ‖c·x[rows]‖ over the probe
+    chunk's live plan entries — the *input-weighted* relative coefficient
+    error of this exact apply, against the lossless path's exact
+    coefficients.  Resolved deferred like every probe into a
+    ``compress_rel_err`` gauge + ``compress_drift`` event, so a solve-long
+    drift SERIES exists where the one-shot compress-check gate measures
+    error once."""
+    if not probes_enabled():
+        return
+    item = ("drift", {"engine": engine, "apply": int(apply_index),
+                      "tier": str(tier), "chunk": int(chunk)},
+            {"num": num, "den": den})
+    if health_mode() == "strict":
+        _resolve(item)
+        return
+    _pending.append(item)
+
+
 def _resolve(item) -> None:
     kind, fields, scalars = item
     try:
@@ -177,6 +200,13 @@ def _resolve(item) -> None:
         log_warn(f"health probe fetch failed ({fields}): {e!r}")
         return
     engine = fields.get("engine", "")
+    if kind == "drift":
+        num, den = float(vals["num"]), float(vals["den"])
+        rel = num / max(den, 1e-300)
+        gauge("compress_rel_err", engine=engine,
+              tier=fields.get("tier", "")).set(rel)
+        emit("compress_drift", rel_err=rel, **fields)
+        return
     if kind == "probe":
         bad = int(vals["nonfinite"])
         norm = float(vals["norm"])

@@ -75,6 +75,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.operator import Operator
 from ..obs import annotate, counter, emit, histogram, obs_enabled
+from ..obs import trace as obs_trace
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
 from ..obs import phases as obs_phases
@@ -1540,8 +1541,25 @@ class DistributedEngine:
             agree=self._codec_agree if self._multi else None)
         enc_bytes = 0
         nrec = 0
-        for per in self._plan_chunks:
+        spec = self._codec.spec
+        keep_drift_ref = (spec["tier"] in ("f32", "bf16")
+                          and spec["coeff"] != "dict"
+                          and spec["ckind"] == "real")
+        for ci, per in enumerate(self._plan_chunks):
             for d in list(per):
+                if keep_drift_ref and ci == self._DRIFT_CHUNK:
+                    # raw-fallback quantized tier: the exact f64
+                    # coefficients are about to be quantized away — keep
+                    # the probe chunk's compact form so the drift probe
+                    # (obs/health.py compress_rel_err) still has its
+                    # lossless reference (dict-coded plans keep the
+                    # originals in the dictionary instead)
+                    cp = self._codec.compact_raw(per[d])
+                    ref = getattr(self, "_drift_raw_ref", None)
+                    if ref is None:
+                        ref = self._drift_raw_ref = {}
+                    ref[d] = (cp["row"], cp["coeff"].real.astype(
+                        np.float64), cp["dest"])
                 per[d] = self._codec.encode_chunk(per[d], d)
                 enc_bytes += PC.PlanCodec.encoded_bytes(per[d])
                 nrec += 1
@@ -2096,27 +2114,32 @@ class DistributedEngine:
             pending = self._upload_plan_chunk(0) if nchunks else None
             for ci in range(nchunks):
                 entry = {"chunk": ci}
-                if record_stall:
-                    # the wait below is the stream's whole performance
-                    # story: ~0 when the upload finished while the device
-                    # ran the previous chunk, the H2D lag otherwise.  It
-                    # exists ONLY to feed the metric — dispatch tracks the
-                    # transfer dependency itself — so DMT_OBS=off skips
-                    # the host sync entirely
-                    _t0 = time.perf_counter()
-                    jax.block_until_ready(pending)
-                    stall_ms = (time.perf_counter() - _t0) * 1e3
-                    histogram("plan_stream_stall_ms").observe(stall_ms)
-                    entry["stall_ms"] = round(stall_ms, 4)
-                _td = time.perf_counter()
-                y = chunk_prog(xp, y, jnp.int32(ci * B), *pending,
-                               self._cdict_dev)
-                if timeline is not None:
-                    entry["dispatch_ms"] = round(
-                        (time.perf_counter() - _td) * 1e3, 4)
-                    timeline.append(entry)
-                if ci + 1 < nchunks:
-                    pending = self._upload_plan_chunk(ci + 1)
+                # chunk span: H2D wait + dispatch of one streamed plan
+                # chunk.  A rank wedged here (stuck disk read, dead H2D)
+                # leaves this span open, so the heartbeat's stall_report
+                # names the exact chunk the rank died on
+                with obs_trace.span("chunk", kind="chunk", chunk=ci):
+                    if record_stall:
+                        # the wait below is the stream's whole performance
+                        # story: ~0 when the upload finished while the
+                        # device ran the previous chunk, the H2D lag
+                        # otherwise.  It exists ONLY to feed the metric —
+                        # dispatch tracks the transfer dependency itself —
+                        # so DMT_OBS=off skips the host sync entirely
+                        _t0 = time.perf_counter()
+                        jax.block_until_ready(pending)
+                        stall_ms = (time.perf_counter() - _t0) * 1e3
+                        histogram("plan_stream_stall_ms").observe(stall_ms)
+                        entry["stall_ms"] = round(stall_ms, 4)
+                    _td = time.perf_counter()
+                    y = chunk_prog(xp, y, jnp.int32(ci * B), *pending,
+                                   self._cdict_dev)
+                    if timeline is not None:
+                        entry["dispatch_ms"] = round(
+                            (time.perf_counter() - _td) * 1e3, 4)
+                        timeline.append(entry)
+                    if ci + 1 < nchunks:
+                        pending = self._upload_plan_chunk(ci + 1)
             if timeline is not None:
                 self._stream_timeline.extend(timeline)
             return epi_prog(y, x, self._diag)
@@ -2628,6 +2651,15 @@ class DistributedEngine:
                         phase="apply", n_states=int(self.n_states))
 
     def _matvec_impl(self, xh, check: Optional[bool] = None) -> jax.Array:
+        # apply span: every event this apply emits (matvec_apply,
+        # apply_phases, chunk spans, health probes) attributes to it —
+        # pure host bookkeeping, the apply program is byte-identical with
+        # tracing on or off (guard-tested by `make trace-check`)
+        with obs_trace.span("apply", kind="apply", engine="distributed",
+                            mode=self.mode, apply=self._apply_idx):
+            return self._matvec_body(xh, check)
+
+    def _matvec_body(self, xh, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()
@@ -2687,6 +2719,12 @@ class DistributedEngine:
                                                    overflow, invalid)
             if obs_health.probe_due(idx):
                 obs_health.probe_apply("distributed", y, idx)
+                if self.mode == "streamed" \
+                        and self._compress in ("f32", "bf16"):
+                    # lossy-tier drift sample rides the same cadence: a
+                    # solve-long compress_rel_err series catches the
+                    # accumulation the one-shot compress-check gate can't
+                    self._probe_compress_drift(xh, idx)
             if obs_memory.watermark_due(idx):
                 obs_memory.sample_watermark("apply/distributed", apply=idx)
         dt_ms = (time.perf_counter() - _t0) * 1e3
@@ -2837,6 +2875,105 @@ class DistributedEngine:
         B = self._last_program_key or self.batch_size
         nchunks = -(-self.shard_size // max(B, 1))
         return nmy * nchunks * D * cap * (8 + tail_elems * 8)
+
+    # -- lossy-tier numerical-drift probe ----------------------------------
+
+    #: the probe chunk: the drift sample is a 1-in-N subsample by
+    #: construction (one chunk's live plan entries, probe-cadence applies)
+    _DRIFT_CHUNK = 0
+
+    def _drift_probe_state(self):
+        """Lazy state for the compressed-drift probe: the probe chunk's
+        x-row indices, EXACT (lossless-path) coefficients and quantization
+        deltas as device-resident arrays for the first addressable shard.
+        None when the probe does not apply — non-quantized tier, complex /
+        pair sector (the bench-gated quantized tiers are real), or a
+        sidecar-restored raw-fallback plan whose exact f64 coefficients
+        are no longer recoverable (dict-coded plans keep the originals as
+        the searchsorted key space, so restore still probes)."""
+        st = getattr(self, "_drift_state", None)
+        if st is not None:
+            return st or None       # False sentinel: checked, unavailable
+        self._drift_state = False
+        codec = getattr(self, "_codec", None)
+        if codec is None or codec.spec["tier"] not in ("f32", "bf16") \
+                or codec.spec["ckind"] != "real" or self.pair:
+            return None
+        from ..ops.plan_codec import _quantize
+        try:
+            per = self._plan_chunk_host(self._DRIFT_CHUNK)
+            d = min(per)
+            if codec.spec["coeff"] == "dict":
+                dec = codec.decode_chunk_host(per[d], d)
+                codes = np.asarray(per[d]["coeff"], np.int64)
+                exact = codec.dicts[d][codes].real.astype(np.float64)
+                rows, dest = dec["row"], dec["dest"]
+            else:
+                stash = getattr(self, "_drift_raw_ref", None)
+                if not stash or d not in stash:
+                    log_debug("compress-drift probe unavailable: "
+                              "raw-fallback coefficients restored from "
+                              "sidecar (exact values not kept)")
+                    return None
+                rows, exact, dest = stash[d]
+            live = np.asarray(dest) < int(codec.spec["n_recv"])
+            exact = np.where(live, exact, 0.0)
+            delta = _quantize(exact, codec.spec["tier"]) - exact
+            self._drift_state = {"d": int(d),
+                                 "rows": np.asarray(rows, np.int32),
+                                 "exact": exact, "delta": delta,
+                                 "dev": {}, "progs": {}}
+        except Exception as e:      # a failed probe must not cost the run
+            from ..utils.logging import log_warn
+            log_warn(f"compress-drift probe disabled: {e!r}")
+            return None
+        return self._drift_state
+
+    def _probe_compress_drift(self, xh, idx: int) -> None:
+        """Dispatch one input-weighted drift sample for a quantized-tier
+        streamed apply (probe-cadence only, piggybacking ``health_every``):
+        ‖Δc·x[rows]‖ / ‖c·x[rows]‖ over the probe chunk's live entries,
+        where Δc is the lossless-vs-quantized coefficient difference.  A
+        separate tiny program — the apply HLO is untouched — with the
+        scalars parked on the health layer's deferred-fetch queue (no sync
+        lands on the hot path)."""
+        st = self._drift_probe_state()
+        if st is None:
+            return
+        d = st["d"]
+        D = self.n_devices
+        xs = None
+        for s in xh.addressable_shards:
+            i0 = s.index[0]
+            start = i0.start or 0
+            stop = i0.stop if i0.stop is not None else D
+            if start <= d < stop:
+                xs = s.data[d - start]
+                break
+        if xs is None:          # shard moved out of this process's reach
+            return
+        dev = next(iter(xs.devices()), None)
+        ref = st["dev"].get(dev)
+        if ref is None:
+            # pin the reference arrays next to the shard they probe — a
+            # one-time H2D per device, not a per-probe transfer
+            ref = st["dev"][dev] = tuple(
+                jax.device_put(a, dev) for a in
+                (st["rows"], st["exact"], st["delta"]))
+        prog = st["progs"].get(xs.shape)
+        if prog is None:
+            def _drift(xv, rows, exact, delta):
+                g = xv[rows]                         # [n] or [n, k]
+                if g.ndim == 2:
+                    exact, delta = exact[:, None], delta[:, None]
+                num = jnp.sqrt(jnp.sum((delta * g) ** 2))
+                den = jnp.sqrt(jnp.sum((exact * g) ** 2))
+                return num, den
+            prog = st["progs"][xs.shape] = jax.jit(_drift)
+        num, den = prog(xs, *ref)
+        obs_health.defer_compress_drift(
+            "distributed", idx, self._compress, self._DRIFT_CHUNK,
+            num, den)
 
     def _validate_counters(self, overflow: int, invalid: int, key) -> None:
         """Raise loudly when the drain counters report lost amplitudes —

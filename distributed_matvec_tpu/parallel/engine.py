@@ -44,6 +44,7 @@ import numpy as np
 from ..models.operator import Operator
 from ..obs import annotate, counter, emit, gauge, histogram
 from ..obs import phases as obs_phases
+from ..obs import trace as obs_trace
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
 from ..obs.events import obs_enabled
@@ -1425,6 +1426,14 @@ class LocalEngine:
                         n_states=int(self.n_states))
 
     def _matvec_impl(self, x, check: Optional[bool] = None) -> jax.Array:
+        # apply span: the matvec_apply/apply_phases/health events emitted
+        # inside attribute to this apply (pure host bookkeeping — the
+        # program run is byte-identical with tracing on or off)
+        with obs_trace.span("apply", kind="apply", engine="local",
+                            mode=self.mode, apply=self._apply_idx):
+            return self._matvec_body(x, check)
+
+    def _matvec_body(self, x, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()

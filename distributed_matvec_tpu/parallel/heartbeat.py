@@ -40,9 +40,15 @@ EXIT_STALLED = 76
 
 
 def _default_on_stall(report: dict) -> None:
+    doing = ""
+    if report.get("span_path"):
+        doing = f"; this rank was in [{report['span_path']}]"
+        sp = report.get("span") or {}
+        if sp.get("kind") == "chunk":
+            doing += f" (chunk {sp.get('chunk')})"
     log_warn(f"peer rank(s) stalled: {report['stalled']} "
-             f"(ages {report['ages_s']}, timeout {report['timeout_s']} s); "
-             "aborting so the supervisor can relaunch and resume")
+             f"(ages {report['ages_s']}, timeout {report['timeout_s']} s)"
+             f"{doing}; aborting so the supervisor can relaunch and resume")
     # os._exit, not sys.exit: the main thread is (by hypothesis) wedged in
     # a collective and will never unwind a SystemExit raised here
     os._exit(EXIT_STALLED)
@@ -133,6 +139,25 @@ class HeartbeatWatchdog:
             report = self.scan()
             if report is not None and not self._stalled:
                 self._stalled = True
+                try:
+                    # attach what THIS rank was doing when the peer went
+                    # stale: the deepest open span (e.g. apply #12 /
+                    # chunk 3) plus the full ancestry — a watchdog exit
+                    # names the stuck phase, not just the stuck rank.
+                    # (The wedged MAIN thread can't report its own state;
+                    # the span stack is process-global precisely so this
+                    # daemon thread can read it.)  Bounded lock waits:
+                    # the abort below must fire even if the main thread
+                    # died HOLDING the trace lock.
+                    from ..obs import trace as obs_trace
+
+                    sp = obs_trace.deepest_span(timeout=1.0)
+                    if sp is not None:
+                        report["span"] = sp
+                        report["span_path"] = obs_trace.span_path(
+                            timeout=1.0)
+                except Exception:
+                    pass
                 try:
                     from ..obs import health as obs_health
                     from ..obs.events import emit, flush

@@ -42,6 +42,7 @@ from scipy.linalg import eigh
 
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
+from ..obs import trace as obs_trace
 from ..obs.events import emit as obs_emit, flush as obs_flush, obs_enabled
 from ..utils import faults, preempt
 
@@ -565,7 +566,18 @@ def _make_restart(mcap, shape, dtype, l):
     return restart
 
 
-def lanczos_block(
+def lanczos_block(matvec: Callable, *args, **kwargs) -> LanczosResult:
+    """Solve-span wrapper over :func:`_lanczos_block_impl` (see there for
+    the full contract): the solver call is ONE ``solve`` span, each block
+    step an ``iteration`` span, and the eager engine applies inside nest
+    as ``apply`` spans — the span tree ``obs_report trace`` exports."""
+    with obs_trace.span("lanczos_block", kind="solve",
+                        k=int(kwargs.get("k", args[1] if len(args) > 1
+                                          else 1))):
+        return _lanczos_block_impl(matvec, *args, **kwargs)
+
+
+def _lanczos_block_impl(
     matvec: Callable,
     n: Optional[int] = None,
     k: int = 1,
@@ -705,21 +717,27 @@ def lanczos_block(
             mem_h.release()
             raise preempt.Preempted("lanczos_block", total, None)
         t0 = _time.perf_counter()
-        Qj = blocks[-1]
-        # step 0 reuses the probe's apply (timed via probe_s below)
-        W = (W0 if j == 0 else mv(Qj)).astype(dtype)
-        W0 = None
-        A = Qj.conj().T @ W
-        W = W - Qj @ A
-        if j > 0:
-            W = W - blocks[-2] @ B_list[-1].conj().T
-        # full reorthogonalization, two passes (classic block-Lanczos loss
-        # of orthogonality is what makes the naive recurrence useless)
-        for _ in range(2):
-            for Qi in blocks:
-                W = W - Qi @ (Qi.conj().T @ W)
-        Qn, B = jnp.linalg.qr(W)
-        jax.block_until_ready(Qn)
+        # iteration span: one block step (p matvec columns + the block
+        # recurrence) — the eager engine apply inside nests as its child
+        with obs_trace.span("iteration", kind="iteration",
+                            solver="lanczos_block", iter=int(total),
+                            block=j):
+            Qj = blocks[-1]
+            # step 0 reuses the probe's apply (timed via probe_s below)
+            W = (W0 if j == 0 else mv(Qj)).astype(dtype)
+            W0 = None
+            A = Qj.conj().T @ W
+            W = W - Qj @ A
+            if j > 0:
+                W = W - blocks[-2] @ B_list[-1].conj().T
+            # full reorthogonalization, two passes (classic block-Lanczos
+            # loss of orthogonality is what makes the naive recurrence
+            # useless)
+            for _ in range(2):
+                for Qi in blocks:
+                    W = W - Qi @ (Qi.conj().T @ W)
+            Qn, B = jnp.linalg.qr(W)
+            jax.block_until_ready(Qn)
         dt = _time.perf_counter() - t0
         if j == 0:
             first_block_s, first_block_iters = dt + probe_s, p
@@ -805,7 +823,19 @@ def lanczos_block(
     )
 
 
-def lanczos(
+def lanczos(matvec: Callable, *args, **kwargs) -> LanczosResult:
+    """Solve-span wrapper over :func:`_lanczos_impl` — the whole solver
+    call (setup, restore, every iteration block, the eigenvector
+    epilogue) becomes ONE ``solve`` span, so a traced run's events nest
+    iteration ⊂ solve even across preemption exits.  See
+    :func:`_lanczos_impl` for the full contract."""
+    with obs_trace.span("lanczos", kind="solve",
+                        k=int(kwargs.get("k", args[1] if len(args) > 1
+                                          else 1))):
+        return _lanczos_impl(matvec, *args, **kwargs)
+
+
+def _lanczos_impl(
     matvec: Callable,
     n: Optional[int] = None,
     k: int = 1,
@@ -1111,9 +1141,15 @@ def lanczos(
                      or nsteps < max(check_every // 2, 1))
         pending_full = False
         t0 = _time.perf_counter()
-        V, alph_d, bet_d = run_steps(
-            used_full, V, alph_d, bet_d, m, nsteps, operands)
-        jax.block_until_ready(V)   # one collective program in flight at a time
+        # iteration span: one convergence-check block of nsteps Lanczos
+        # steps (the applies run INSIDE the jitted block program, so the
+        # block is the finest host-visible iteration granule here)
+        with obs_trace.span("iteration", kind="iteration",
+                            solver="lanczos", iter=int(total_iters),
+                            steps=int(nsteps)):
+            V, alph_d, bet_d = run_steps(
+                used_full, V, alph_d, bet_d, m, nsteps, operands)
+            jax.block_until_ready(V)   # one collective program in flight
         if selective and not used_full:
             om_acc = omega_tr.advance(np.asarray(alph_d),
                                       np.asarray(bet_d), m + nsteps)
@@ -1132,9 +1168,13 @@ def lanczos(
                          check="selective_reorth_fallback", level="info",
                          solver="lanczos", iter=int(total_iters + nsteps),
                          omega=float(om_acc))
-                V, alph_d, bet_d = run_steps(
-                    True, V, alph_d, bet_d, m, nsteps, operands)
-                jax.block_until_ready(V)
+                with obs_trace.span("iteration", kind="iteration",
+                                    solver="lanczos",
+                                    iter=int(total_iters),
+                                    steps=int(nsteps), redo=True):
+                    V, alph_d, bet_d = run_steps(
+                        True, V, alph_d, bet_d, m, nsteps, operands)
+                    jax.block_until_ready(V)
                 used_full = True
         dt = _time.perf_counter() - t0
         if first_block_iters == 0:

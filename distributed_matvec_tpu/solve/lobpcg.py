@@ -36,6 +36,7 @@ import numpy as np
 
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
+from ..obs import trace as obs_trace
 from ..obs.events import emit as obs_emit, flush as obs_flush, obs_enabled
 from ..utils import preempt
 from .lanczos import _operator_key, _restore_ckpt, _soft_save_ckpt
@@ -77,7 +78,18 @@ def _norm_estimate(matvec: Callable, n: int, iters: int = 20, seed: int = 3):
     return 1.05 * lam
 
 
-def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
+def lobpcg(matvec: Callable, n: int, *args, **kwargs
+           ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Solve-span wrapper over :func:`_lobpcg_impl` (see there for the
+    full contract): the whole LOBPCG call is ONE ``solve`` span and each
+    checkpoint segment an ``iteration`` span — the causal tree
+    ``obs_report trace`` exports."""
+    with obs_trace.span("lobpcg", kind="solve",
+                        k=int(kwargs.get("k", args[0] if args else 1))):
+        return _lobpcg_impl(matvec, n, *args, **kwargs)
+
+
+def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
            tol: float = 1e-9, seed: int = 0,
            X0: Optional[np.ndarray] = None,
            pair: Optional[bool] = None,
@@ -224,7 +236,11 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         while done < max_iters:
             seg = (max_iters - done) if not checkpoint_path else \
                 min(max(int(checkpoint_every), 1), max_iters - done)
-            theta, U, it = lobpcg_standard(flip, X, m=seg, tol=tol)
+            # iteration span: one LOBPCG segment (seg driven iterations)
+            with obs_trace.span("iteration", kind="iteration",
+                                solver="lobpcg", iter=int(done),
+                                steps=int(seg)):
+                theta, U, it = lobpcg_standard(flip, X, m=seg, tol=tol)
             done += int(it)
             X = U
             if not checkpoint_path:
@@ -384,7 +400,10 @@ def lobpcg(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             while done < max_iters:
                 seg = (max_iters - done) if not checkpoint_path else \
                     min(max(int(checkpoint_every), 1), max_iters - done)
-                theta, U, it = _run(X, gram_li(X), operands, seg)
+                with obs_trace.span("iteration", kind="iteration",
+                                    solver="lobpcg", iter=int(done),
+                                    steps=int(seg)):
+                    theta, U, it = _run(X, gram_li(X), operands, seg)
                 done += int(it)
                 X = U
                 if not checkpoint_path:

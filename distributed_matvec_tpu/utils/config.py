@@ -45,6 +45,17 @@ class RuntimeConfig:
     #   device.memory_stats() into hbm_bytes_in_use/hbm_peak_bytes gauges
     #   and a memory_watermark event; backends without stats (CPU) latch
     #   off after the first miss (obs/memory.py)
+    trace: str = "on"                      # end-to-end solve tracing
+    #   (DMT_TRACE, obs/trace.py): "on" stamps every event's envelope with
+    #   trace_id/job_id/span_id and emits one `span` event per closed span
+    #   (solve > iteration > apply > chunk) — pure host bookkeeping, the
+    #   apply HLO is byte-identical on or off (guard-tested by `make
+    #   trace-check`); "off" disables stamping + span events while the
+    #   rest of the obs layer keeps running (obs off implies off)
+    job_id: str = ""                       # job-namespacing id
+    #   (DMT_JOB_ID): stamped into every event envelope; empty defaults to
+    #   the run's trace id.  The groundwork the solve service needs to
+    #   multiplex many concurrent jobs' telemetry through shared engines
     phases: str = "on"                     # per-apply phase attribution
     #   (DMT_PHASES): "on" emits one `apply_phases` event per eager apply
     #   (host-side structural counts only — the apply HLO is byte-identical

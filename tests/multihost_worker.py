@@ -63,6 +63,28 @@ def _finish_obs():
     obs.flush()
 
 
+if os.environ.get("DMT_MH_TRACE"):
+    # Trimmed leg for the end-to-end TRACING test: a streamed engine per
+    # rank over a RANK-LOCAL mesh (same CPU-backend constraint as the
+    # fast leg below) driven by a small block-Lanczos solve — every eager
+    # apply nests apply ⊂ iteration ⊂ solve in the span stack, the chunk
+    # loop adds chunk spans, and both ranks agree on one trace id through
+    # the shared run directory.  Correctness still asserted so a broken
+    # solve cannot masquerade as a tracing pass.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.solve import lanczos_block
+
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="streamed")
+    res = lanczos_block(eng.matvec, k=1, tol=1e-8, max_iters=24, seed=3)
+    e0 = float(res.eigenvalues[0])
+    print(f"[p{pid}] trace leg: E0/4 = {e0 / 4:.10f} "
+          f"({res.num_iters} iters)", flush=True)
+    assert abs(e0 / 4 - E0_OVER_4) < 5e-3, e0   # truncated solve: coarse
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

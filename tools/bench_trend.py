@@ -56,16 +56,23 @@ METRIC_WHITELIST = (
     "stream_steady_speedup", "plan_bytes", "plan_build_s",
     "plan_stream_stall_ms", "apply_wall_ms", "speedup_vs_numpy",
     "plan_bytes_encoded", "compress_ratio", "compressed_steady_apply_ms",
-    "compress_steady_speedup", "compress_rel_err",
+    "compress_steady_speedup", "compress_rel_err", "compress_drift_max",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
 #: ``obs_report diff``).  ``compress_ratio`` guards the plan codec: a PR
 #: that quietly gives back the encoded-bytes win fails the gate even if
-#: wall clocks hold.
+#: wall clocks hold.  The drift pair (``compress_rel_err`` one-shot vs
+#: fused, ``compress_drift_max`` worst probe-cadence sample — both
+#: cost-like, error growth is the regression per obs_report's direction
+#: rule) guards the lossy tiers' NUMERICS: quantized coefficients whose
+#: error quietly grows fail the gate even when wall clocks and ratios
+#: hold.  Lossless runs record 0.0, which the gate skips as a baseline —
+#: the pair only arms on quantized-tier records.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
-                "lanczos_iters_per_s")
+                "lanczos_iters_per_s", "compress_rel_err",
+                "compress_drift_max")
 
 
 def _keep(metric: str) -> bool:
@@ -73,9 +80,17 @@ def _keep(metric: str) -> bool:
 
 
 def compact_record(detail: dict, mode: str, backend: str,
-                   ts: Optional[float] = None) -> dict:
+                   ts: Optional[float] = None,
+                   trace_id: Optional[str] = None,
+                   job_id: Optional[str] = None,
+                   obs_dir: Optional[str] = None) -> dict:
     """One trend record from a BENCH_DETAIL-style dict
-    (``{config_key: {metrics...}}``, ``main`` included)."""
+    (``{config_key: {metrics...}}``, ``main`` included).
+
+    ``trace_id``/``job_id``/``obs_dir`` stamp the record with its RUN
+    identity: a gated trend regression greps straight back to the exact
+    run directory (and Perfetto trace) that produced it, instead of "some
+    earlier bench run"."""
     configs: Dict[str, dict] = {}
     for key, rec in sorted(detail.items()):
         if not isinstance(rec, dict) or "error" in rec:
@@ -86,9 +101,16 @@ def compact_record(detail: dict, mode: str, backend: str,
                 and not isinstance(v, bool)}
         if vals:
             configs[name] = vals
-    return {"kind": KIND, "ts": round(ts if ts is not None else time.time(),
-                                      3),
-            "mode": str(mode), "backend": str(backend), "configs": configs}
+    out = {"kind": KIND, "ts": round(ts if ts is not None else time.time(),
+                                     3),
+           "mode": str(mode), "backend": str(backend), "configs": configs}
+    if trace_id:
+        out["trace_id"] = str(trace_id)
+    if job_id:
+        out["job_id"] = str(job_id)
+    if obs_dir:
+        out["obs_dir"] = str(obs_dir)
+    return out
 
 
 def append_record(path: str, record: dict) -> bool:
@@ -200,9 +222,14 @@ def render_trend(records: List[dict], configs: Optional[List[str]],
           f"(oldest -> newest):")
     for r in recs:
         when = time.strftime("%Y-%m-%d %H:%M", time.localtime(r["ts"]))
+        ident = ""
+        if r.get("trace_id"):
+            ident = f"  trace={str(r['trace_id'])[:8]}"
+            if r.get("obs_dir"):
+                ident += f" dir={r['obs_dir']}"
         print(f"  {when}  mode={r.get('mode'):<12} "
               f"backend={r.get('backend'):<4} "
-              f"configs={len(r.get('configs', {}))}")
+              f"configs={len(r.get('configs', {}))}{ident}")
     series: Dict[tuple, List[Optional[float]]] = {}
     for i, r in enumerate(recs):
         for cfg, vals in r.get("configs", {}).items():
@@ -314,6 +341,14 @@ def main(argv=None) -> int:
     if regressions:
         print(f"\nREGRESSION: {len(regressions)} gated series beyond "
               f"{args.threshold:.0%}")
+        if newest.get("trace_id"):
+            # the run identity stamped by bench.py: grep the regressed
+            # run's own telemetry instead of guessing which run it was
+            print(f"  regressed run: trace_id={newest['trace_id']}"
+                  + (f" job_id={newest['job_id']}"
+                     if newest.get("job_id") else "")
+                  + (f" obs_dir={newest['obs_dir']}"
+                     if newest.get("obs_dir") else ""))
         return 1
     print(f"\nno trend regression beyond {args.threshold:.0%}")
     return 0

@@ -72,6 +72,24 @@ Subcommands::
         ending in ``*`` matches by prefix.  This is the CI perf gate
         `make obs-check` runs against the recorded BENCH_DETAIL.json.
 
+    trace RUN [-o OUT.json]
+        Chrome/Perfetto trace-event export of the merged span tree
+        (``obs/trace.py``): one process per rank, track 0 the recorded
+        spans (solve > iteration > apply > chunk as nested B/E pairs),
+        track 1 the per-apply phase split derived from ``apply_phases``
+        (matched by envelope ``span_id``), counter tracks for HBM in use,
+        solver ritz/residual, and lossy-tier drift.  Load the JSON in
+        ui.perfetto.dev (or chrome://tracing).
+
+    watch RUN [--once] [--interval 1.0] [--window 60]
+        Live terminal dashboard over the rank streams (tails every
+        ``rank_<r>/events.jsonl`` with the same rotation-safe machinery
+        as ``tail --follow``): apply count/rate per rank, per-phase time
+        split, solver convergence (ritz/residual), cross-rank straggler
+        skew, health/fault/stall counters, lossy-tier drift, HBM/host
+        watermarks.  ``--once`` renders a single frame and exits (CI and
+        scripts); otherwise refreshes in place every ``--interval``.
+
     tail RUN [-n 20] [--follow]
         Human-readable view of the last events; ``--follow`` keeps reading
         as a live run appends (rotated/recreated files are reopened on
@@ -91,7 +109,10 @@ from typing import Dict, List, Optional
 
 # Metrics where a LOWER value in the new run is the regression (rates,
 # speedups); everything else numeric is treated as cost-like (ms, seconds,
-# bytes, iteration counts) where HIGHER is the regression.
+# bytes, iteration counts) where HIGHER is the regression — which is the
+# DELIBERATE registration for the lossy-tier drift metrics
+# (compress_rel_err, compress_drift_max): numerical error growing is the
+# regression, so they gate correctly under the default rule.
 _HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate",
                      "compress_ratio")
 
@@ -408,7 +429,15 @@ def run_summary(events: List[dict]) -> dict:
                                 "invalid", "omega") if k in ev}
         for ev in events if ev.get("kind") in ("health", "solver_health")]
 
+    ident = {}
+    for ev in events:
+        if ev.get("trace_id"):
+            ident = {"trace_id": ev["trace_id"],
+                     "job_id": ev.get("job_id")}
+            break
+
     return {"n_events": len(events),
+            "identity": ident,
             "processes": sorted({_rank_of(ev) for ev in events}),
             "engine_inits": inits,
             "cache": cache,
@@ -425,7 +454,13 @@ def _fmt_seconds(v) -> str:
 
 
 def print_summary(s: dict) -> None:
-    print(f"events: {s['n_events']}  processes: {s['processes']}")
+    ident = s.get("identity") or {}
+    tag = ""
+    if ident.get("trace_id"):
+        tag = f"  trace_id: {ident['trace_id']}"
+        if ident.get("job_id") and ident["job_id"] != ident["trace_id"]:
+            tag += f"  job_id: {ident['job_id']}"
+    print(f"events: {s['n_events']}  processes: {s['processes']}{tag}")
     if s["engine_inits"]:
         print("\nengine inits (seconds; split from the construction timers):")
         print(f"  {'engine':<12} {'mode':<8} {'N':<10}"
@@ -837,6 +872,527 @@ def print_diff(rows, regressions, common, threshold, all_metrics) -> None:
 
 
 # ---------------------------------------------------------------------------
+# trace (Chrome/Perfetto trace-event export of the merged span tree)
+
+#: payload keys of a `span` event that are structure, not display args
+_SPAN_STRUCT = ("seq", "ts", "proc", "rank", "n_ranks", "kind", "trace_id",
+                "job_id", "span_id", "parent_span_id", "name", "cat", "t0",
+                "dur_ms", "ts_adj")
+
+
+def span_forest(events, offsets: Optional[Dict[int, float]] = None) -> Dict:
+    """{rank: [root span record, ...]} from ``span`` events, skew-corrected
+    into the merge's common clock.  Each record:
+    ``{sid, parent, name, cat, t0, t1, args, children}`` with children
+    sorted by start time.  A span whose parent never closed (crash,
+    preemption) becomes a root — the tree degrades, it does not drop."""
+    if offsets is None:
+        offsets = estimate_skew(events)
+    spans: Dict[tuple, dict] = {}
+    for ev in events:
+        if ev.get("kind") != "span" or not ev.get("span_id") \
+                or ev.get("t0") is None:
+            continue
+        r = _rank_of(ev)
+        t0 = float(ev["t0"]) - offsets.get(r, 0.0)
+        spans[(r, str(ev["span_id"]))] = {
+            "sid": str(ev["span_id"]),
+            "parent": (str(ev["parent_span_id"])
+                       if ev.get("parent_span_id") else None),
+            "name": str(ev.get("name", "span")),
+            "cat": str(ev.get("cat", "span")),
+            "t0": t0,
+            "t1": t0 + float(ev.get("dur_ms") or 0.0) / 1e3,
+            "args": {k: v for k, v in ev.items() if k not in _SPAN_STRUCT},
+            "children": [],
+        }
+    forest: Dict[int, list] = {}
+    for (r, sid), rec in sorted(spans.items()):
+        parent = spans.get((r, rec["parent"])) if rec["parent"] else None
+        if parent is not None:
+            parent["children"].append(rec)
+        else:
+            forest.setdefault(r, []).append(rec)
+    for rec in spans.values():
+        rec["children"].sort(key=lambda c: c["t0"])
+    for roots in forest.values():
+        roots.sort(key=lambda c: c["t0"])
+    return forest
+
+
+def _attributed_phase_ms(phases: Dict[str, dict], wall_ms: float,
+                         measured_key: str) -> List[tuple]:
+    """ONE shared implementation of the report-time phase attribution
+    (obs/phases.py contract): ``[(phase, ms)]`` over the canonical order —
+    measured walls verbatim (``measured_key`` names the field:
+    ``wall_ms`` on raw ``apply_phases`` records, ``measured_wall_ms`` on
+    the :func:`phases_summary` digest), the remainder split proportional
+    to structural bytes, leftover appended as ``overhead``.  Both the
+    Perfetto phase track and the watch phase line call this — the rule
+    must not drift between them."""
+    measured = {p: float(rec[measured_key]) for p, rec in phases.items()
+                if rec.get(measured_key) is not None}
+    rest = [p for p in _PHASE_ORDER if p in phases and p not in measured]
+    rem = max(wall_ms - sum(measured.values()), 0.0)
+    weights = {p: float(phases[p].get("bytes") or 0) for p in rest}
+    wsum = sum(weights.values())
+    out = []
+    used = 0.0
+    for p in _PHASE_ORDER:
+        if p not in phases:
+            continue
+        if p in measured:
+            ms = measured[p]
+        elif wsum:
+            ms = rem * weights[p] / wsum
+        elif rest:
+            ms = rem / len(rest)
+        else:
+            ms = 0.0
+        out.append((p, ms))
+        used += ms
+    if wall_ms - used > 1e-9:
+        out.append(("overhead", wall_ms - used))
+    return out
+
+
+def _phase_segments(pev: dict, t0: float, t1: float):
+    """Split one apply's wall [t0, t1] into sequential phase intervals
+    via :func:`_attributed_phase_ms` (approximate by construction and
+    labeled as such in the track name), clamped into the apply span."""
+    segs = []
+    cur = t0
+    for p, ms in _attributed_phase_ms(pev.get("phases") or {},
+                                      (t1 - t0) * 1e3, "wall_ms"):
+        d = max(min(ms / 1e3, t1 - cur), 0.0)
+        if d > 0:
+            segs.append((p, cur, cur + d))
+        cur += d
+    return segs
+
+
+def perfetto_trace(events) -> dict:
+    """The run as a Chrome/Perfetto trace-event JSON: one process per
+    rank; track 0 the recorded span tree (solve > iteration > apply >
+    chunk, B/E pairs), track 1 the per-apply phase split derived from
+    each apply's ``apply_phases`` event (matched by the envelope
+    ``span_id``), plus counter tracks (HBM in use, solver ritz/residual,
+    lossy-tier drift).  Cross-rank alignment uses the skew-corrected
+    merge, so the i-th apply lines up across rank tracks."""
+    merged, offsets = merge_events(events)
+    forest = span_forest(merged, offsets)
+    ranks = sorted({_rank_of(ev) for ev in merged})
+    # apply_phases events keyed by their apply span (envelope span_id)
+    phase_evs: Dict[tuple, dict] = {}
+    for ev in merged:
+        if ev.get("kind") == "apply_phases" and ev.get("span_id"):
+            phase_evs[(_rank_of(ev), str(ev["span_id"]))] = ev
+
+    t_candidates = [rec["t0"] for roots in forest.values() for rec in roots]
+    t_candidates += [ev["ts_adj"] for ev in merged if "ts_adj" in ev]
+    t_base = min(t_candidates) if t_candidates else 0.0
+
+    def us(t: float) -> float:
+        return round((t - t_base) * 1e6, 1)
+
+    te: List[dict] = []
+    for r in ranks:
+        te.append({"ph": "M", "pid": r, "tid": 0, "name": "process_name",
+                   "args": {"name": f"rank {r}"}})
+        te.append({"ph": "M", "pid": r, "tid": 0, "name": "thread_name",
+                   "args": {"name": "spans"}})
+        te.append({"ph": "M", "pid": r, "tid": 1, "name": "thread_name",
+                   "args": {"name": "phases (attributed)"}})
+
+    def walk(rec: dict, lo: float, hi: float, pid: int) -> None:
+        # clamp into the parent and keep siblings sequential — sub-µs
+        # clock rounding must never produce an unbalanced B/E pair
+        t0 = min(max(rec["t0"], lo), hi)
+        t1 = min(max(rec["t1"], t0), hi)
+        te.append({"ph": "B", "pid": pid, "tid": 0, "ts": us(t0),
+                   "name": rec["name"], "cat": rec["cat"],
+                   "args": dict(rec["args"], span_id=rec["sid"])})
+        cursor = t0
+        for child in rec["children"]:
+            walk(child, max(cursor, t0), t1, pid)
+            cursor = max(cursor, min(max(child["t1"], child["t0"]), t1))
+        te.append({"ph": "E", "pid": pid, "tid": 0, "ts": us(t1)})
+        if rec["cat"] == "apply":
+            pev = phase_evs.get((pid, rec["sid"]))
+            if pev is not None:
+                label = f"apply #{rec['args'].get('apply', '?')}"
+                te.append({"ph": "B", "pid": pid, "tid": 1, "ts": us(t0),
+                           "name": label, "cat": "apply"})
+                for p, s0, s1 in _phase_segments(pev, t0, t1):
+                    te.append({"ph": "B", "pid": pid, "tid": 1,
+                               "ts": us(s0), "name": p, "cat": "phase"})
+                    te.append({"ph": "E", "pid": pid, "tid": 1,
+                               "ts": us(s1)})
+                te.append({"ph": "E", "pid": pid, "tid": 1, "ts": us(t1)})
+
+    for r in ranks:
+        for root in forest.get(r, []):
+            walk(root, root["t0"], max(root["t1"], root["t0"]), r)
+
+    # counter (value) tracks from the gauge-bearing events
+    for ev in merged:
+        r, ts = _rank_of(ev), ev.get("ts_adj")
+        if ts is None:
+            continue
+        kind = ev.get("kind")
+        if kind == "memory_watermark" \
+                and ev.get("bytes_in_use") is not None:
+            te.append({"ph": "C", "pid": r, "ts": us(ts),
+                       "name": "hbm_bytes_in_use",
+                       "args": {"bytes": int(ev["bytes_in_use"])}})
+        elif kind == "lanczos_trace":
+            ritz = ev.get("ritz") or []
+            res = ev.get("residual") or []
+            if ritz:
+                te.append({"ph": "C", "pid": r, "ts": us(ts),
+                           "name": "ritz0",
+                           "args": {"value": float(ritz[0])}})
+            if res:
+                te.append({"ph": "C", "pid": r, "ts": us(ts),
+                           "name": "residual_max",
+                           "args": {"value": float(max(res))}})
+        elif kind == "compress_drift" and ev.get("rel_err") is not None:
+            te.append({"ph": "C", "pid": r, "ts": us(ts),
+                       "name": "compress_rel_err",
+                       "args": {"value": float(ev["rel_err"])}})
+
+    ident = {}
+    for ev in merged:
+        if ev.get("trace_id"):
+            ident = {"trace_id": ev["trace_id"],
+                     "job_id": ev.get("job_id")}
+            break
+    return {"traceEvents": te, "displayTimeUnit": "ms",
+            "otherData": dict(ident, ranks=ranks,
+                              skew_s={str(r): round(o, 6)
+                                      for r, o in offsets.items()})}
+
+
+def validate_trace_events(te: List[dict]) -> None:
+    """Stack-check the B/E pairing per (pid, tid): every E matches the
+    innermost open B and every track closes balanced.  Raises ValueError
+    — the trace-check gate and the 2-process test call this on the
+    export."""
+    stacks: Dict[tuple, list] = {}
+    for ev in te:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev)
+        else:
+            if not stacks.get(key):
+                raise ValueError(f"unbalanced E on track {key}")
+            stacks[key].pop()
+    for key, st in stacks.items():
+        if st:
+            raise ValueError(
+                f"{len(st)} unclosed B event(s) on track {key}: "
+                f"{[e.get('name') for e in st]}")
+
+
+# ---------------------------------------------------------------------------
+# watch (live terminal dashboard over the rank streams)
+
+#: sliding window for the apply-rate column (seconds of event time)
+_WATCH_WINDOW_S = 60.0
+
+
+def empty_watch_base() -> dict:
+    """Carried aggregates of events already TRIMMED from a live watch's
+    window (see :func:`watch_fold`): total counts survive the trim, while
+    rate/solver/phase state only ever needs the retained tail."""
+    return {"n_events": 0, "applies": {}, "bytes": {},
+            "health": {"warn": 0, "critical": 0, "faults": 0,
+                       "io_retries": 0, "stalls": 0}}
+
+
+def watch_fold(base: dict, dropped: List[dict]) -> dict:
+    """Fold trimmed events' countable state into ``base`` so a bounded
+    live watch still reports exact lifetime totals."""
+    for ev in dropped:
+        base["n_events"] += 1
+        r = _rank_of(ev)
+        kind = ev.get("kind")
+        if kind == "matvec_apply":
+            base["applies"][r] = base["applies"].get(r, 0) + 1
+            base["bytes"][r] = base["bytes"].get(r, 0) \
+                + int(ev.get("bytes") or 0)
+        elif kind in ("health", "solver_health"):
+            lv = str(ev.get("level"))
+            if lv in ("warn", "critical"):
+                base["health"][lv] += 1
+        elif kind == "fault_injected":
+            base["health"]["faults"] += 1
+        elif kind == "io_retry":
+            base["health"]["io_retries"] += 1
+        elif kind == "stall_report":
+            base["health"]["stalls"] += 1
+    return base
+
+
+def watch_state(events, window_s: float = _WATCH_WINDOW_S,
+                base: Optional[dict] = None) -> dict:
+    """Aggregate one frame's worth of dashboard state from an event list
+    (plus ``base``, the carried totals of already-trimmed events in live
+    mode).  Pure function of its inputs (``now`` = the newest timestamp),
+    so a recorded stream renders a deterministic frame — the golden-frame
+    test pins the format."""
+    offsets = estimate_skew(events)
+    ranks = sorted({_rank_of(ev) for ev in events}
+                   | set((base or {}).get("applies", ())))
+    now = max((float(ev["ts"]) for ev in events if "ts" in ev),
+              default=0.0)
+    per_rank: Dict[int, dict] = {
+        r: {"applies": 0, "recent": 0, "last_wall_ms": None,
+            "bytes": 0, "hbm": None, "hbm_peak": None, "host": None}
+        for r in ranks}
+    solver = None
+    solver_done = None
+    health = {"warn": 0, "critical": 0, "faults": 0, "io_retries": 0,
+              "stalls": 0}
+    drift = None
+    ident: Dict[str, str] = {}
+    for ev in events:
+        r = _rank_of(ev)
+        kind = ev.get("kind")
+        if not ident and ev.get("trace_id"):
+            ident = {"trace_id": str(ev["trace_id"]),
+                     "job_id": str(ev.get("job_id") or "")}
+        if kind == "matvec_apply":
+            row = per_rank[r]
+            row["applies"] += 1
+            row["bytes"] += int(ev.get("bytes") or 0)
+            if ev.get("wall_ms") is not None:
+                row["last_wall_ms"] = float(ev["wall_ms"])
+            if "ts" in ev and float(ev["ts"]) >= now - window_s:
+                row["recent"] += 1
+        elif kind == "lanczos_trace":
+            solver = {"solver": str(ev.get("solver")),
+                      "iter": ev.get("iter"),
+                      "basis": ev.get("basis_size"),
+                      "ritz0": (ev.get("ritz") or [None])[0],
+                      "res_max": max(ev["residual"])
+                      if ev.get("residual") else None}
+        elif kind == "solver_end":
+            solver_done = {"solver": str(ev.get("solver")),
+                           "converged": bool(ev.get("converged")),
+                           "iters": ev.get("iters")}
+        elif kind in ("health", "solver_health"):
+            lv = str(ev.get("level"))
+            if lv in ("warn", "critical"):
+                health[lv] += 1
+        elif kind == "fault_injected":
+            health["faults"] += 1
+        elif kind == "io_retry":
+            health["io_retries"] += 1
+        elif kind == "stall_report":
+            health["stalls"] += 1
+        elif kind == "memory_watermark":
+            row = per_rank[r]
+            if ev.get("bytes_in_use") is not None:
+                row["hbm"] = int(ev["bytes_in_use"])
+            if ev.get("peak_bytes") is not None:
+                row["hbm_peak"] = max(row["hbm_peak"] or 0,
+                                      int(ev["peak_bytes"]))
+        elif kind == "memory_ledger":
+            if ev.get("total_bytes") is not None:
+                per_rank[r]["host"] = int(ev["total_bytes"])
+        elif kind == "compress_drift":
+            if ev.get("rel_err") is not None:
+                drift = float(ev["rel_err"])
+    n_events = len(events)
+    if base:
+        n_events += base["n_events"]
+        for r, n in base["applies"].items():
+            per_rank[r]["applies"] += n
+        for r, b in base["bytes"].items():
+            per_rank[r]["bytes"] += b
+        for k, v in base["health"].items():
+            health[k] += v
+    strag = straggler_report(events, offsets)
+    return {"ident": ident, "ranks": ranks, "n_events": n_events,
+            "now": now, "window_s": window_s, "per_rank": per_rank,
+            "phases": phases_summary(events), "solver": solver,
+            "solver_done": solver_done, "straggler": strag,
+            "health": health, "drift": drift}
+
+
+def _fmt_rate(n: int, window_s: float) -> str:
+    return f"{n / window_s:.2f}/s"
+
+
+def render_watch(state: dict) -> str:
+    """One dashboard frame (plain text, ~10 lines): apply rate per rank,
+    per-phase time split, solver convergence, straggler skew, health /
+    fault counters, memory watermarks.  Format is pinned by the
+    golden-frame test — extend by appending lines, not reshaping."""
+    ident = state.get("ident") or {}
+    head = (f"obs watch | trace {str(ident.get('trace_id', '-'))[:8]}"
+            f" | job {str(ident.get('job_id', '-'))[:8]}"
+            f" | {len(state['ranks'])} rank(s)"
+            f" | {state['n_events']} events")
+    lines = [head, "-" * len(head)]
+    cells = []
+    for r in state["ranks"]:
+        row = state["per_rank"][r]
+        wall = (f"{row['last_wall_ms']:.1f} ms"
+                if row["last_wall_ms"] is not None else "-")
+        cells.append(f"rank{r}: {row['applies']} "
+                     f"({_fmt_rate(row['recent'], state['window_s'])}, "
+                     f"last {wall})")
+    lines.append("applies   " + "   ".join(cells) if cells
+                 else "applies   (none yet)")
+    for key, grp in sorted((state.get("phases") or {}).items()):
+        parts = []
+        wall = grp.get("mean_wall_ms") or 0.0
+        for p, ms in _attributed_phase_ms(grp.get("phases") or {}, wall,
+                                          "measured_wall_ms"):
+            if wall <= 0 or ms <= 0:
+                continue
+            if p == "overhead" and ms <= 0.05 * wall:
+                continue        # sub-noise remainder: not worth a column
+            parts.append(f"{p} {100 * ms / wall:.0f}%")
+        if parts:
+            lines.append(f"phases    {key}: " + " | ".join(parts)
+                         + f"  ({wall:.1f} ms/apply)")
+    sv = state.get("solver")
+    if sv is not None:
+        ritz = (f"{sv['ritz0']:.8f}" if sv.get("ritz0") is not None
+                else "-")
+        res = (f"{sv['res_max']:.2e}" if sv.get("res_max") is not None
+               else "-")
+        done = state.get("solver_done")
+        tail = ""
+        if done and done.get("solver") == sv.get("solver"):
+            tail = ("  [converged]" if done["converged"]
+                    else "  [ended, not converged]")
+        lines.append(f"solver    {sv['solver']}: iter {sv['iter']}, "
+                     f"basis {sv['basis']}, ritz0 {ritz}, "
+                     f"max res {res}{tail}")
+    strag = state.get("straggler") or {}
+    if strag.get("applies"):
+        per = strag["per_rank"]
+        worst_rank = max(per, key=lambda r: per[r]["barrier_wait_ms"])
+        w = (strag.get("worst") or [{}])[0] if strag.get("worst") else {}
+        worst_txt = (f" (worst apply #{w.get('apply')} rank "
+                     f"{w.get('rank')} +{w.get('excess_ms'):.1f} ms)"
+                     if w else "")
+        lines.append(
+            f"skew      rank{worst_rank} waits "
+            f"{per[worst_rank]['barrier_wait_ms']:.2f} ms/apply at the "
+            f"barrier over {strag['applies']} aligned applies"
+            f"{worst_txt}")
+    h = state["health"]
+    drift = state.get("drift")
+    lines.append(f"health    warn {h['warn']}, critical {h['critical']} | "
+                 f"faults {h['faults']}, io_retries {h['io_retries']}, "
+                 f"stalls {h['stalls']} | drift "
+                 + (f"{drift:.2e}" if drift is not None else "-"))
+    mems = []
+    for r in state["ranks"]:
+        row = state["per_rank"][r]
+        if row["hbm"] is None and row["hbm_peak"] is None \
+                and row["host"] is None:
+            continue
+        mems.append(f"rank{r}: hbm {_fmt_bytes(row['hbm'])} "
+                    f"(peak {_fmt_bytes(row['hbm_peak'])}, "
+                    f"host ledger {_fmt_bytes(row['host'])})")
+    if mems:
+        lines.append("memory    " + " | ".join(mems))
+    return "\n".join(lines)
+
+
+def watch_frame(events, window_s: float = _WATCH_WINDOW_S) -> str:
+    """One rendered frame from an event list (the pure composition the
+    golden test pins)."""
+    return render_watch(watch_state(events, window_s))
+
+
+#: live-mode window bound: beyond this many retained events the oldest
+#: half is folded into the carried totals (watch_fold) and dropped, so a
+#: multi-hour watch holds constant memory and O(window) work per frame
+_WATCH_MAX_EVENTS = 60_000
+
+
+def _watch_seed(files: List[str]):
+    """Initial live-mode load that seeds the follow state with the byte
+    offset actually CONSUMED (an append landing mid-read is picked up by
+    the next poll instead of being skipped — the bug a
+    ``getsize``-after-``load_events`` seed would have) and buffers a torn
+    final line exactly like :func:`_follow_poll`."""
+    events: List[dict] = []
+    state: Dict[str, tuple] = {}
+    partial: Dict[str, str] = {}
+    for f in files:
+        ident = _stat_id(f)
+        if ident is None:
+            continue
+        try:
+            with open(f, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            continue
+        state[f] = (ident, len(data), data[:64])
+        lines = data.decode("utf-8", "replace").split("\n")
+        if lines[-1]:
+            partial[f] = lines[-1]
+        for line in lines[:-1]:
+            if not line.strip():
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return events, state, partial
+
+
+def watch_run(path: str, once: bool, interval: float,
+              window_s: float) -> int:
+    """The ``watch`` subcommand: render a frame; with ``--once`` print it
+    and exit, else refresh in place, tailing every rank stream with the
+    same rotation-safe follow machinery as ``tail --follow`` (late-joining
+    ranks are picked up each poll)."""
+    if once:
+        try:
+            events = list(load_events(path))
+        except FileNotFoundError as e:
+            print(f"watch: {e}", file=sys.stderr)
+            return 2
+        print(watch_frame(events, window_s))
+        return 0
+    # live mode: an empty/not-yet-created run dir just renders an empty
+    # frame until the first rank starts writing
+    files = _run_files(path) if os.path.isdir(path) else [path]
+    events, state, partial = _watch_seed(files)
+    base = empty_watch_base()
+    try:
+        while True:
+            frame = render_watch(watch_state(events, window_s, base))
+            # home + clear-to-end: repaint in place without flicker
+            sys.stdout.write("\x1b[H\x1b[2J" + frame
+                             + f"\n\n(refreshing every {interval:g}s — "
+                               "Ctrl-C to stop)\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+            if os.path.isdir(path):
+                files = _run_files(path)
+            events.extend(_follow_poll(files, state, partial))
+            if len(events) > _WATCH_MAX_EVENTS:
+                cut = len(events) - _WATCH_MAX_EVENTS // 2
+                watch_fold(base, events[:cut])
+                del events[:cut]
+    except KeyboardInterrupt:
+        return 0
+
+
+# ---------------------------------------------------------------------------
 # tail
 
 
@@ -1022,6 +1578,24 @@ def main(argv=None) -> int:
     p.add_argument("--all-metrics", action="store_true",
                    help="print every common metric, not just gated/changed")
 
+    p = sub.add_parser("trace", help="Perfetto trace-event export of the "
+                                     "merged span tree")
+    p.add_argument("run", help="run dir with rank_*/ (or a .jsonl file)")
+    p.add_argument("-o", "--out", default=None, metavar="OUT.json",
+                   help="write the trace JSON here (default: stdout)")
+
+    p = sub.add_parser("watch", help="live terminal dashboard over the "
+                                     "rank streams")
+    p.add_argument("run", help="run dir (or .jsonl) of a live or "
+                               "recorded run")
+    p.add_argument("--once", action="store_true",
+                   help="render a single frame and exit")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period in seconds (default 1.0)")
+    p.add_argument("--window", type=float, default=_WATCH_WINDOW_S,
+                   help="apply-rate sliding window in seconds of event "
+                        "time (default 60)")
+
     p = sub.add_parser("tail", help="view the last events of a run")
     p.add_argument("run")
     p.add_argument("-n", type=int, default=20)
@@ -1091,6 +1665,30 @@ def main(argv=None) -> int:
         else:
             _roofline.print_roofline(report)
         return 0
+
+    if args.cmd == "trace":
+        trace = perfetto_trace(load_events(args.run))
+        n_spans = sum(1 for ev in trace["traceEvents"]
+                      if ev.get("ph") == "B" and ev.get("tid") == 0)
+        validate_trace_events(trace["traceEvents"])
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(trace, f)
+            other = trace["otherData"]
+            print(f"[obs_report] wrote {args.out}: {n_spans} span(s) "
+                  f"across rank(s) {other.get('ranks')}, "
+                  f"trace_id={other.get('trace_id')} — open in "
+                  "ui.perfetto.dev", file=sys.stderr)
+        else:
+            print(json.dumps(trace))
+        if n_spans == 0:
+            print("[obs_report] no span events in the run — record with "
+                  "tracing on (DMT_TRACE defaults on)", file=sys.stderr)
+            return 2
+        return 0
+
+    if args.cmd == "watch":
+        return watch_run(args.run, args.once, args.interval, args.window)
 
     if args.cmd == "diff":
         base = bench_metrics(load_events(args.base))

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""trace-check — CI gate for end-to-end solve tracing (`make trace-check`).
+
+Asserts, on the CPU rig:
+
+1. **HLO byte-identity** — the apply program is byte-identical with
+   tracing on (`DMT_TRACE=on`, the default) and off, for the local ell
+   apply AND the distributed streamed chunk path: spans are host
+   bookkeeping, never device work (the health-probe contract of
+   DESIGN.md §18 applied to causality, §24).
+2. **DMT_OBS=off is a provable no-op** — `span()` returns the shared
+   null context, no trace/job id is generated, zero span events are
+   emitted across engine applies.
+3. **A recorded 2-rank run exports a valid Perfetto trace** — the
+   multihost worker's trace leg (rank-local streamed engines driven by a
+   block-Lanczos solve under a REAL 2-process jax.distributed job)
+   produces one agreed trace id, and `obs_report trace` emits balanced
+   B/E pairs nesting chunk ⊂ apply ⊂ iteration ⊂ solve on both rank
+   tracks (checked by the same stack validator the tests use).
+4. **`obs_report watch --once` renders a frame** from that run without
+   error, carrying the apply, solver-convergence, and health sections.
+
+Deterministic, ~60 s on the CPU rig.
+"""
+
+import os
+import subprocess
+import sys
+
+# platform pins BEFORE any jax import (same discipline as tests/conftest)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+# the gate asserts the DEFAULT enablement and uses its own scratch run —
+# inherited telemetry/trace state must not leak in or out
+for var in ("DMT_TRACE", "DMT_TRACE_ID", "DMT_JOB_ID", "DMT_OBS",
+            "DMT_OBS_DIR", "DMT_MH_TRACE", "DMT_MH_FAST"):
+    os.environ.pop(var, None)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def main() -> int:
+    import json
+    import socket
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="dmt_trace_check_")
+    os.environ["DMT_ARTIFACT_CACHE"] = "off"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import obs_report
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.lattices import (
+        chain_edges, heisenberg_from_edges)
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    ns = 12
+    basis = SpinBasis(number_spins=ns, hamming_weight=ns // 2)
+    op = heisenberg_from_edges(basis, chain_edges(ns))
+    basis.build()
+    n = basis.number_states
+    print(f"[trace-check] chain_{ns}: N={n}")
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(n)
+    x /= np.linalg.norm(x)
+
+    # -- 1. HLO byte-identity, tracing on vs off ---------------------------
+    def apply_hlo(eng, xarg):
+        return jax.jit(eng._apply_fn).lower(
+            xarg, eng._operands).compile().as_text()
+
+    el = LocalEngine(op, mode="ell")
+    es = DistributedEngine(op, n_devices=2, mode="streamed")
+    xj = jnp.asarray(x)
+    xh = es.to_hashed(x)
+    assert obs.trace_enabled(), "tracing should default on"
+    hlo_local_on = apply_hlo(el, xj)
+    es.matvec(xh)
+    el.matvec(xj)
+    assert obs.events("span"), "no span events while tracing is on"
+    os.environ["DMT_TRACE"] = "off"
+    try:
+        assert not obs.trace_enabled()
+        n_sp = len(obs.events("span"))
+        el.matvec(xj)
+        es.matvec(xh)
+        assert len(obs.events("span")) == n_sp, \
+            "span events emitted with DMT_TRACE=off"
+        assert apply_hlo(el, xj) == hlo_local_on, \
+            "local apply HLO changed with tracing off"
+        # streamed chunk result must match bit-for-bit on/off (the chunk
+        # loop only gained host spans): compare against the traced apply
+        y_off = np.asarray(es.matvec(xh))
+    finally:
+        os.environ.pop("DMT_TRACE", None)
+    y_on = np.asarray(es.matvec(xh))
+    assert np.array_equal(y_on, y_off), \
+        "streamed apply result changed with tracing off"
+    print("[trace-check] HLO byte-identity + streamed bit-identity "
+          "(trace on/off): OK")
+
+    # -- 2. DMT_OBS=off: provable no-op ------------------------------------
+    os.environ["DMT_OBS"] = "off"
+    try:
+        from contextlib import nullcontext
+
+        assert isinstance(obs.span("x", kind="solve"), nullcontext)
+        assert obs.trace_id() is None and obs.job_id() is None
+        n_sp = len(obs.events("span"))
+        el.matvec(xj)
+        es.matvec(xh)
+        assert len(obs.events("span")) == n_sp, \
+            "span events emitted with DMT_OBS=off"
+    finally:
+        os.environ.pop("DMT_OBS", None)
+    print("[trace-check] DMT_OBS=off emits zero spans: OK")
+
+    # -- 3. recorded 2-rank run -> valid Perfetto export -------------------
+    run_dir = os.path.join(scratch, "run")
+    worker = os.path.join(_REPO, "tests", "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_TRACE"] = "1"
+    env["DMT_OBS_DIR"] = run_dir
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-2000:]}"
+    events = obs_report.load_events(run_dir)
+    tids = {e.get("trace_id") for e in events}
+    assert len(tids) == 1 and None not in tids, \
+        f"ranks disagree on the trace id: {tids}"
+    trace = json.loads(json.dumps(obs_report.perfetto_trace(events)))
+    te = trace["traceEvents"]
+    obs_report.validate_trace_events(te)
+    for pid in (0, 1):
+        stack, seen = [], set()
+        for ev in te:
+            if ev.get("pid") != pid or ev.get("tid") != 0:
+                continue
+            if ev.get("ph") == "B":
+                stack.append(ev["cat"])
+                seen.add(tuple(stack))
+            elif ev.get("ph") == "E":
+                stack.pop()
+        assert ("solve", "iteration", "apply", "chunk") in seen, \
+            f"rank {pid}: span tree never nested " \
+            "solve>iteration>apply>chunk"
+    out_json = os.path.join(scratch, "trace.json")
+    rc = obs_report.main(["trace", run_dir, "-o", out_json])
+    assert rc == 0, f"obs_report trace exited {rc}"
+    with open(out_json) as f:
+        obs_report.validate_trace_events(json.load(f)["traceEvents"])
+    print(f"[trace-check] 2-rank Perfetto export "
+          f"({len(te)} trace events, trace_id={next(iter(tids))}): OK")
+
+    # -- 4. watch --once renders a frame -----------------------------------
+    frame = obs_report.watch_frame(events)
+    for section in ("obs watch", "applies", "solver", "health"):
+        assert section in frame, f"watch frame missing {section!r}:\n{frame}"
+    rc = obs_report.main(["watch", run_dir, "--once"])
+    assert rc == 0, f"obs_report watch --once exited {rc}"
+    print("[trace-check] watch --once frame: OK")
+    print("[trace-check] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
