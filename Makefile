@@ -13,7 +13,7 @@ HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
-	roofline-check compress-check trace-check clean
+	roofline-check compress-check trace-check pipeline-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -23,6 +23,7 @@ check:
 	$(MAKE) stream-check
 	$(MAKE) compress-check
 	$(MAKE) roofline-check
+	$(MAKE) pipeline-check
 	$(MAKE) trace-check
 	$(MAKE) fault-check
 
@@ -111,6 +112,18 @@ compress-check:
 # synthetic 10x regression.  Deterministic, ~30 s on the CPU rig.
 roofline-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/roofline_check.py
+
+# Pipelined-apply gate (tools/pipeline_check.py): bit-identity of
+# pipelined vs sequential applies (fused + streamed, single + k=3 batch,
+# counters preserved), the PR-7 pipelined-apply estimate reconciling
+# against the measured pipelined wall within 25% (retried for timing
+# noise), a REAL 2-process run with a deterministic 8 ms/chunk staging
+# latency injected on rank 1 showing the `report --ranks` time-at-barrier
+# cut >= 2x with pipeline_depth=4 (the straggling rank's steady applies
+# faster too), and the PROGRESS.jsonl trend gate firing on a synthetic
+# barrier_ms regression.  Deterministic, ~45 s on the CPU rig.
+pipeline-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/pipeline_check.py
 
 # Tracing gate (tools/trace_check.py): apply HLO byte-identity with
 # tracing on vs off (local ell; streamed result bit-identity rides

@@ -306,7 +306,13 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     ``plan_bytes_encoded`` / ``compress_ratio`` /
     ``compressed_steady_apply_ms`` plus the measured relative error vs
     fused — the numbers the PROGRESS.jsonl trend gate guards
-    (tools/bench_trend.py) and the compress-check gate asserts."""
+    (tools/bench_trend.py) and the compress-check gate asserts.  The
+    fourth leg re-runs the streamed engine PIPELINED (DESIGN.md §25,
+    ``pipeline_depth=4``) and records ``pipelined_steady_apply_ms``, the
+    measured ``barrier_ms`` time-at-barrier and ``overlap_fraction``
+    from the apply_phases pipeline split, with bit-identity against
+    fused riding along — ``barrier_ms`` and ``pipelined_steady_apply_ms``
+    join the default trend-gate set."""
     import jax
 
     from distributed_matvec_tpu.obs.metrics import histogram as _hist
@@ -327,18 +333,29 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     y_ref = None
     cfg = get_config()
     saved_tier = cfg.stream_compress
-    legs = (("fused", None), ("streamed", "off"),
-            ("compressed", compress_tier))
+    # every leg pins its pipeline depth explicitly so the recorded
+    # numbers keep their identity regardless of ambient DMT_PIPELINE
+    legs = (("fused", None, 0), ("streamed", "off", 0),
+            ("compressed", compress_tier, 0), ("pipelined", "off", 4))
     try:
-        for leg, tier in legs:
+        for leg, tier, pipe_depth in legs:
             mode = "fused" if leg == "fused" else "streamed"
             if tier is not None:
                 cfg.stream_compress = tier
             _progress(f"{name}: {leg} engine"
                       + (f" (stream_compress={tier})"
-                         if leg == "compressed" else ""))
+                         if leg == "compressed" else "")
+                      + (f" (pipeline_depth={pipe_depth})"
+                         if leg == "pipelined" else ""))
             t0 = time.perf_counter()
-            eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+            # the pipelined leg keeps the default chunking (bit-identity
+            # to fused requires the SAME chunk/accumulation order): on a
+            # config whose plan is a single chunk the depth knob resolves
+            # itself to sequential and the leg records pipeline_depth=0 —
+            # the honest reading; multi-chunk configs (the real targets)
+            # exercise the pipeline
+            eng = DistributedEngine(op, n_devices=n_devices, mode=mode,
+                                    pipeline_depth=pipe_depth)
             init_s = time.perf_counter() - t0
             xh = eng.to_hashed(x)
             stall = _hist("plan_stream_stall_ms")
@@ -377,6 +394,35 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
                                 out[f"phase_{p}_{fld}"] = int(rec[fld])
                         if rec.get("wall_ms") is not None:
                             out[f"phase_{p}_ms"] = rec["wall_ms"]
+            elif leg == "pipelined":
+                # pipelined tier-off stream: bit-identical to fused by
+                # the §25 accumulation-order contract, with the measured
+                # overlap/time-at-barrier split averaged over the steady
+                # applies.  Only THIS engine's pipeline records count —
+                # depth 0 (single-chunk plan) must record nothing, not an
+                # earlier config's events from the shared buffer.
+                out["pipelined_bit_identical"] = bool(
+                    np.array_equal(y_ref, np.asarray(yh)))
+                out["pipeline_depth"] = int(eng.pipeline_depth)
+                if eng.pipeline_depth >= 2:
+                    pev = [e for e in obs.events("apply_phases")
+                           if e.get("engine") == "distributed"
+                           and e.get("mode") == "streamed"
+                           and (e.get("pipeline") or {}).get("depth")
+                           == eng.pipeline_depth]
+                    # mean over the steady applies (the last `repeats`
+                    # events) — a single apply's barrier sample is too
+                    # noisy to trend-gate
+                    recs = [e["pipeline"] for e in pev[-repeats:]]
+                    bar = [float(p["barrier_ms"]) for p in recs
+                           if p.get("barrier_ms") is not None]
+                    frac = [float(p["overlap_fraction"]) for p in recs
+                            if p.get("overlap_fraction") is not None]
+                    if bar:
+                        out["barrier_ms"] = round(sum(bar) / len(bar), 4)
+                    if frac:
+                        out["overlap_fraction"] = round(
+                            sum(frac) / len(frac), 4)
             else:
                 y_c = np.asarray(yh)
                 scale = max(float(np.max(np.abs(y_ref))), 1e-300)
@@ -404,6 +450,9 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     out["compress_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
         / max(out["compressed_steady_apply_ms"], 1e-9), 2)
+    out["pipelined_steady_speedup"] = round(
+        out["fused_steady_apply_ms"]
+        / max(out["pipelined_steady_apply_ms"], 1e-9), 2)
     obs.emit("bench_result", **out)
     return out
 

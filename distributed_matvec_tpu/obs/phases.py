@@ -101,17 +101,25 @@ def emit_apply_phases(engine: str, mode: str, apply_index: int,
                       wall_ms: float, counts: Dict[str, Dict[str, int]],
                       chunks: int = 1, columns: int = 1,
                       measured_ms: Optional[Dict[str, float]] = None,
-                      chunk_timeline: Optional[list] = None
+                      chunk_timeline: Optional[list] = None,
+                      pipeline: Optional[dict] = None
                       ) -> Optional[dict]:
     """Record one apply's phase decomposition.
 
     ``counts`` maps phase → ``{bytes, gathers, flops}`` (structural, exact);
     ``measured_ms`` carries phases whose wall time was *measured* host-side
-    (streamed mode's ``plan_h2d`` H2D waits) rather than model-attributed;
+    (streamed mode's ``plan_h2d`` H2D waits; a pipelined apply's exposed
+    ``exchange`` dispatch wall) rather than model-attributed;
     ``chunk_timeline`` is the streamed per-chunk record
     ``[{chunk, stall_ms, dispatch_ms}, ...]`` the pipelined-apply estimate
-    reads.  Totals are computed here so readers (and the exactness tests)
-    never re-derive them."""
+    reads; ``pipeline`` carries the measured overlap/time-at-barrier split
+    of a pipelined apply (``{depth, barrier_ms, hidden_ms,
+    overlap_fraction}`` — DESIGN.md §25): ``barrier_ms`` is the host wall
+    actually EXPOSED waiting on plan staging / exchange feeds,
+    ``hidden_ms`` the staging work that ran behind chunk compute, and a
+    measured ``exchange`` phase beating its bound renders ``hidden`` in
+    the roofline report (= overlap working).  Totals are computed here so
+    readers (and the exactness tests) never re-derive them."""
     if not phases_enabled():
         return None
     totals = {"bytes": 0, "gathers": 0, "flops": 0}
@@ -132,4 +140,8 @@ def emit_apply_phases(engine: str, mode: str, apply_index: int,
           "flops_total": totals["flops"]}
     if chunk_timeline:
         ev["chunk_timeline"] = chunk_timeline
+    if pipeline:
+        ev["pipeline"] = {k: (round(float(v), 4)
+                              if isinstance(v, float) else v)
+                          for k, v in pipeline.items()}
     return emit("apply_phases", **ev)

@@ -51,6 +51,7 @@ __all__ = [
     "resolve_calibration",
     "phase_bounds_ms",
     "attribute_phases",
+    "choose_pipeline_depth",
     "roofline_report",
     "print_roofline",
     "reconcile_error",
@@ -251,6 +252,53 @@ def attribute_phases(phases: Dict[str, dict], wall_ms: float,
     return out
 
 
+#: ``pipeline="auto"`` arms only when the priced overlappable time (the
+#: exchange/compute overlap plus the whole hideable plan stream) is at
+#: least this share of the apply's total bound — below it the pipeline's
+#: bookkeeping (split programs, prefetch workers) cannot pay for itself
+#: (measured ~7% schedule overhead on a latency-free 8-chunk CPU
+#: stream, BENCH_PIPELINE_r10.json).
+AUTO_PIPELINE_MIN_FRACTION = 0.10
+
+#: Depth ``auto`` picks when the plan stream (``plan_h2d``) carries a
+#: meaningful share of the hideable time: staging latency hides best with
+#: several uploads in flight.  A pure compute/exchange overlap needs only
+#: the classic double buffer (depth 2).
+AUTO_PIPELINE_DEEP = 4
+
+
+def choose_pipeline_depth(counts: Dict[str, dict], cal: dict,
+                          nchunks: int, n_devices: int) -> int:
+    """The ``pipeline="auto"`` policy — price the overlap before building
+    it (the same §22 cost model the pipelined-apply estimate uses) and
+    return a depth:
+
+    * 0 (off) when there is nothing to pipeline — a single-chunk apply,
+      or a priced overlappable time (``min(compute, exchange)·(1−1/n)``
+      plus the hideable ``plan_h2d`` stream) below
+      :data:`AUTO_PIPELINE_MIN_FRACTION` of the total bound;
+    * :data:`AUTO_PIPELINE_DEEP` when the plan stream dominates the
+      hideable time (staging latency wants several uploads in flight);
+    * 2 (the classic double buffer) otherwise.
+
+    The depth is clamped to ``nchunks`` by the caller-facing contract
+    (more slots than chunks buy nothing)."""
+    if nchunks < 2:
+        return 0
+    bounds = phase_bounds_ms(counts, cal)
+    total = sum(bounds.values())
+    if total <= 0:
+        return 0
+    comp = bounds.get("compute", 0.0)
+    exch = bounds.get("exchange", 0.0) if n_devices > 1 else 0.0
+    h2d = bounds.get("plan_h2d", 0.0)
+    hideable = min(comp, exch) * (1.0 - 1.0 / nchunks) + h2d
+    if hideable / total < AUTO_PIPELINE_MIN_FRACTION:
+        return 0
+    depth = AUTO_PIPELINE_DEEP if h2d >= 0.5 * hideable else 2
+    return min(depth, nchunks)
+
+
 def _mean(vals: List[float]) -> float:
     return sum(vals) / len(vals) if vals else 0.0
 
@@ -265,13 +313,19 @@ def roofline_report(events: List[dict],
     groups: Dict[tuple, List[dict]] = {}
     for ev in events:
         if ev.get("kind") == "apply_phases" and ev.get("phases"):
+            # pipelined applies form their OWN group per depth: a run that
+            # records sequential AND pipelined applies of one (engine,
+            # mode) reports them side by side — that comparison IS the
+            # measured-vs-priced overlap story below
+            depth = int((ev.get("pipeline") or {}).get("depth") or 0)
             groups.setdefault(
-                (str(ev.get("engine")), str(ev.get("mode"))), []).append(ev)
+                (str(ev.get("engine")), str(ev.get("mode")), depth),
+                []).append(ev)
     out = {"calibration": {k: cal.get(k) for k in
                            RATE_FIELDS + ("backend", "device_kind",
                                           "source")},
            "groups": {}}
-    for (engine, mode), evs in sorted(groups.items()):
+    for (engine, mode, depth), evs in sorted(groups.items()):
         steady = evs[1:] if len(evs) > 1 else evs
         wall = _mean([float(e.get("wall_ms") or 0.0) for e in steady])
         nchunks = max(int(steady[-1].get("chunks") or 1), 1)
@@ -319,7 +373,41 @@ def roofline_report(events: List[dict],
         }
         if stalls:
             grp["mean_chunk_stall_ms"] = round(_mean(stalls), 4)
-        out["groups"][f"{engine}/{mode}"] = grp
+        if depth:
+            pipes = [e.get("pipeline") or {} for e in steady]
+            grp["pipeline_depth"] = depth
+            # only MEASURED values aggregate: a fused pipeline records
+            # depth alone (no host-driven chunk loop), and an absent
+            # measurement must not render as a perfect 0-ms barrier
+            for k in ("barrier_ms", "hidden_ms", "overlap_fraction"):
+                vals = [float(p[k]) for p in pipes
+                        if p.get(k) is not None]
+                if vals:
+                    grp[k] = round(_mean(vals), 4)
+        key = f"{engine}/{mode}" + (f"+pipe{depth}" if depth else "")
+        out["groups"][key] = grp
+    # measured-vs-priced: when a run holds BOTH the sequential and a
+    # pipelined group of one (engine, mode), put the PR-7 estimate (priced
+    # off the sequential phases) next to the measured pipelined wall, and
+    # flag a pipeline whose measured overlap fell below half its estimate
+    # (only when the estimate is worth chasing — a CPU-rig run whose
+    # priced overlap is ~0 must not cry wolf)
+    for key, grp in out["groups"].items():
+        if "+pipe" not in key:
+            continue
+        base = out["groups"].get(key.split("+pipe", 1)[0])
+        if not base or not base.get("wall_ms"):
+            continue
+        wall_b, wall_p = float(base["wall_ms"]), float(grp["wall_ms"])
+        priced_overlap = float(base["pipelined_overlap_ms"])
+        measured_overlap = max(wall_b - wall_p, 0.0)
+        grp["measured_speedup"] = round(wall_b / max(wall_p, 1e-9), 3)
+        grp["priced_speedup"] = base["pipelined_speedup_estimate"]
+        grp["measured_overlap_ms"] = round(measured_overlap, 4)
+        grp["priced_overlap_ms"] = round(priced_overlap, 4)
+        grp["overlap_below_estimate"] = bool(
+            priced_overlap >= 0.02 * wall_b
+            and measured_overlap < 0.5 * priced_overlap)
     return out
 
 
@@ -379,6 +467,30 @@ def print_roofline(report: dict) -> None:
         if grp.get("mean_chunk_stall_ms") is not None:
             print(f"  mean plan-stream chunk stall: "
                   f"{grp['mean_chunk_stall_ms']:.4f} ms")
-        print(f"  pipelined-apply estimate: overlap exchange with chunk "
-              f"compute saves {grp['pipelined_overlap_ms']:.3f} ms "
-              f"-> {grp['pipelined_speedup_estimate']:.2f}x")
+        if grp.get("pipeline_depth"):
+            frac = grp.get("overlap_fraction")
+            if grp.get("barrier_ms") is not None:
+                print(f"  pipeline depth {grp['pipeline_depth']}: "
+                      f"time-at-barrier {grp['barrier_ms']:.4f} ms/apply, "
+                      f"{grp.get('hidden_ms', 0.0):.4f} ms staged behind "
+                      "compute"
+                      + (f" ({frac:.0%} of the staging latency hidden)"
+                         if frac is not None else ""))
+            else:
+                print(f"  pipeline depth {grp['pipeline_depth']} "
+                      "(in-program schedule — no host-measured barrier "
+                      "split)")
+            if grp.get("measured_speedup") is not None:
+                print(f"  measured vs priced: {grp['measured_speedup']:.2f}x"
+                      f" measured ({grp['measured_overlap_ms']:.3f} ms "
+                      f"overlapped) vs {grp['priced_speedup']:.2f}x priced "
+                      f"({grp['priced_overlap_ms']:.3f} ms)")
+                if grp.get("overlap_below_estimate"):
+                    print("  WARNING: measured overlap fell below 50% of "
+                          "the roofline estimate — the pipeline is not "
+                          "hiding what the model priced (check depth, "
+                          "chunk count, and the calibration)")
+        else:
+            print(f"  pipelined-apply estimate: overlap exchange with chunk "
+                  f"compute saves {grp['pipelined_overlap_ms']:.3f} ms "
+                  f"-> {grp['pipelined_speedup_estimate']:.2f}x")

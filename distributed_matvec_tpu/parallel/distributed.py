@@ -54,6 +54,14 @@ Three modes, mirroring :class:`~.engine.LocalEngine`:
   bandwidth.  Bit-identical to ``fused`` for single vectors and k ≤ 4
   batches (same chunking, same bucket math, same accumulation order).
 
+Both chunked modes (fused, streamed) additionally accept ``pipeline_depth``
+(``DMT_PIPELINE``, DESIGN.md §25): a software pipeline that keeps chunk
+*i*'s amplitude exchange in flight while chunk *i+1*'s local
+gather/multiply runs — plan fetches prefetched by worker threads,
+produce/exchange split programs, the exchange decomposed into staged
+``ppermute`` rounds — with exchanges retiring strictly in chunk order, so
+pipelined applies are bit-identical to sequential ones at every depth.
+
 Both modes keep the reference's invariant check: a nonzero amplitude routed
 to a state absent from the basis raises (DistributedMatrixVector.chpl:113-118).
 
@@ -160,6 +168,140 @@ def _bucket_positions(key: jax.Array, D: int) -> jax.Array:
     return pos_s[inv]
 
 
+def _staged_all_to_all(sb, axis_name: str):
+    """The monolithic ``all_to_all`` decomposed into D−1 ``ppermute``
+    rounds plus the local bucket copy — the overlappable-collective-stages
+    decomposition of "Memory-efficient array redistribution through
+    portable collective communication" (PAPERS.md), used by the pipelined
+    apply schedules (DESIGN.md §25).
+
+    ``sb`` is one shard's ``[D, Cap, ...]`` bucketed send buffer; the
+    result is ELEMENT-IDENTICAL to ``all_to_all(sb, axis, 0, 0,
+    tiled=True)``: round ``r`` moves each shard ``i``'s bucket for peer
+    ``(i+r) % D`` and lands it at receive slot ``(i−r) % D``, so the
+    reassembled layout — and every accumulation that follows — is
+    bit-identical to the monolithic exchange.  What changes is the
+    *schedule*: each round is an independent collective the compiler's
+    latency-hiding scheduler can start early and overlap with unrelated
+    compute (the fused pipeline's chunk-ahead gather/multiply), where the
+    single fat ``all_to_all`` is one barrier-shaped rendezvous."""
+    D = sb.shape[0]
+    if D == 1:
+        return sb
+    i = jax.lax.axis_index(axis_name)
+    out = jnp.zeros_like(sb)
+    mine = jax.lax.dynamic_slice_in_dim(sb, i, 1, axis=0)
+    out = jax.lax.dynamic_update_slice_in_dim(out, mine, i, axis=0)
+    for r in range(1, D):
+        perm = [(j, (j + r) % D) for j in range(D)]
+        payload = jax.lax.dynamic_slice_in_dim(sb, (i + r) % D, 1, axis=0)
+        got = jax.lax.ppermute(payload, axis_name, perm)
+        out = jax.lax.dynamic_update_slice_in_dim(out, got, (i - r) % D,
+                                                  axis=0)
+    return out
+
+
+class _PlanPrefetcher:
+    """Depth-bounded background staging of streamed plan chunks — the
+    pipelined apply's H2D side (DESIGN.md §25).
+
+    The sequential apply fetches chunk ``ci+1`` inline between chunk
+    dispatches, so every millisecond of plan I/O (RAM-dict walk, disk-tier
+    read + CRC, retry backoff) lands on the apply's critical path.  Here
+    worker threads run the FETCH (:meth:`DistributedEngine.
+    _fetch_plan_chunk` — GIL-releasing I/O, deliberately NOT the
+    ``device_put`` staging, which would contend with the apply thread's
+    dispatches) up to ``depth`` chunks ahead of the consumer (the
+    backpressure keeps host staging memory bounded at ``depth`` chunks —
+    the H2D analog of the send-slot discipline), and the consumer's
+    measured ``get`` wait is the apply's time-at-barrier: ~0 when the
+    fetch hid behind chunk compute, the exposed latency otherwise.
+
+    One worker when the plan lives on the DISK tier (h5py handles are not
+    thread-safe — reads stay serialized, the CRC check + retry backoff
+    still overlap compute); ``min(depth, 4)`` workers for the RAM tier.
+    Workers NEVER run the corrupt-chunk degrade path (it can dispatch
+    collective build programs and mutate the engine's plan state): a read
+    failure is delivered as a ``degrade`` marker and the consumer repairs
+    on the APPLY thread exactly as the sequential schedule would; any
+    other worker failure is re-raised on the apply thread."""
+
+    def __init__(self, eng, nchunks: int, depth: int, start: int = 0):
+        import threading
+
+        self._eng = eng
+        self._n = int(nchunks)
+        self._depth = max(int(depth), 1)
+        self._cv = threading.Condition()
+        self._ready: dict = {}
+        self._consumed = int(start) - 1
+        self._next = int(start)
+        self._stop = False
+        n_workers = 1 if eng._plan_disk is not None \
+            else min(self._depth, 4)
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"dmt-plan-prefetch-{k}")
+            for k in range(min(n_workers, self._n) or 1)]
+        for t in self._threads:
+            t.start()
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._stop and self._next < self._n
+                       and self._next > self._consumed + self._depth):
+                    self._cv.wait()
+                if self._stop or self._next >= self._n:
+                    return
+                ci = self._next
+                self._next += 1
+            t0 = time.perf_counter()
+            try:
+                res = ("ok", self._eng._fetch_plan_chunk(ci, degrade=False),
+                       (time.perf_counter() - t0) * 1e3)
+            except (OSError, KeyError, ValueError) as e:
+                # a read failure whose HANDLING (degrade/rebuild) belongs
+                # on the apply thread — marker, not a repair
+                res = ("degrade", e, (time.perf_counter() - t0) * 1e3)
+            except BaseException as e:   # re-raised by the consumer
+                res = ("err", e, 0.0)
+            with self._cv:
+                self._ready[ci] = res
+                self._cv.notify_all()
+
+    def get(self, ci: int):
+        """Block until chunk ``ci`` is fetched.  Returns
+        ``(kind, value, stage_ms, wait_ms)`` — ``kind`` is ``"ok"``
+        (value = the fetched host arrays) or ``"degrade"`` (value = the
+        read failure; the consumer repairs on the apply thread);
+        ``stage_ms`` is the worker's fetch wall (the work the pipeline
+        HID), ``wait_ms`` the consumer's exposed wait (the
+        time-at-barrier sample).  Worker errors re-raise here."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while ci not in self._ready:
+                self._cv.wait()
+            kind, val, stage_ms = self._ready.pop(ci)
+            self._consumed = max(self._consumed, ci)
+            self._cv.notify_all()
+        if kind == "err":
+            self.close()
+            raise val
+        return kind, val, stage_ms, (time.perf_counter() - t0) * 1e3
+
+    def close(self, join: bool = False) -> None:
+        """Stop the workers.  ``join=True`` additionally waits them out —
+        the degrade path joins before repairing so no worker still holds
+        the shared (thread-unsafe) h5py handles it is about to touch."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if join:
+            for t in self._threads:
+                t.join()
+
+
 class DistributedEngine:
     """Hash-sharded distributed matvec over a ``jax.sharding.Mesh``.
 
@@ -181,7 +323,8 @@ class DistributedEngine:
                  mode: Optional[str] = None,
                  structure_cache: Optional[str] = None,
                  layout: Optional[HashedLayout] = None,
-                 shards_path: Optional[str] = None):
+                 shards_path: Optional[str] = None,
+                 pipeline_depth=None):
         _t_init = time.perf_counter()
         basis = operator.basis
         #: True when the representatives came from the artifact-cache
@@ -323,6 +466,13 @@ class DistributedEngine:
         #: streamed mode's per-apply chunk timeline (stall + dispatch ms),
         #: drained by _matvec_impl into the apply_phases event
         self._stream_timeline: list = []
+        #: pipelined applies (fused/streamed, DESIGN.md §25): resolved
+        #: depth (0 = sequential — the bit-identical default); the
+        #: constructor argument wins over ``config.pipeline``
+        #: (``DMT_PIPELINE``); resolved per mode below once the chunk
+        #: count is known
+        self._pipeline_req = pipeline_depth
+        self.pipeline_depth = 0
         self._plan_remote_unique: Optional[int] = None
         self._n_my_shards = sum(
             1 for d in range(D) if self._shard_addressable(d))
@@ -481,6 +631,8 @@ class DistributedEngine:
             self._lk_dir = self._assemble_sharded(dir_rows)
             self._capacity = self._fused_capacity()
             if mode == "fused":
+                self.pipeline_depth = self._resolve_pipeline_depth(
+                    -(-M // self.batch_size))
                 self._matvec = self._make_fused_matvec()
             else:
                 # streamed: resolve the fused-class structure ONCE (per
@@ -531,6 +683,8 @@ class DistributedEngine:
                 self._register_stream_plan()
                 import weakref
                 weakref.finalize(self, _close_plan_files, self._plan_files)
+                self.pipeline_depth = self._resolve_pipeline_depth(
+                    self._plan_nchunks_v)
                 self._matvec = self._make_streamed_matvec()
                 # overflow/invalid are structural and validated at plan time
                 # (build or restore) — applies revalidate nothing
@@ -1822,12 +1976,16 @@ class DistributedEngine:
             nn = np.concatenate([nn, np.ones(B - (e - s))])
         return a, nn
 
-    def _plan_chunk_host(self, ci: int) -> dict:
+    def _plan_chunk_host(self, ci: int, degrade: bool = True) -> dict:
         """One chunk's host-side plan arrays per addressable shard — from
         the RAM copy, or read back (checksum-verified, retried) from the
         disk-tier sidecar (the OS page cache makes repeated applies
         stream, not re-read cold).  A persistently corrupt chunk degrades
-        through :meth:`_degrade_plan_chunk` instead of raising mid-apply."""
+        through :meth:`_degrade_plan_chunk` instead of raising mid-apply —
+        unless ``degrade=False`` (the pipelined prefetch workers: the
+        repair dispatches collective programs and mutates plan state, so
+        it must run on the apply thread; the raw failure propagates to
+        the consumer instead)."""
         if self._plan_chunks is not None:
             return self._plan_chunks[ci]
         got = self._plan_repaired.get(ci)
@@ -1841,6 +1999,8 @@ class DistributedEngine:
                     lambda: self._read_plan_chunk(path, d, ci),
                     exc_types=(OSError, KeyError, ValueError))
             except (OSError, KeyError, ValueError) as e:
+                if not degrade:
+                    raise
                 return self._degrade_plan_chunk(ci, path, e)
         return out
 
@@ -1946,32 +2106,102 @@ class DistributedEngine:
         log_debug(f"stream plan chunk {ci} rebuilt from structure")
         return per
 
-    def _upload_plan_chunk(self, ci: int):
-        """Stage one plan chunk onto the mesh ([D, ...] assembled arrays).
-        Dispatched one chunk AHEAD of the apply loop so the H2D copy
-        overlaps the previous chunk's device pass (the PR-1 double-buffer
-        pattern, now on the apply path).  The upload is idempotent (pure
-        H2D of host-resident arrays), so a transient failure is retried
-        with backoff instead of killing a solve mid-apply."""
-        def _stage():
+    def _fetch_plan_chunk(self, ci: int, degrade: bool = True) -> dict:
+        """The latency-bearing HOST half of one plan-chunk upload: the
+        ``plan_upload`` fault site plus the RAM/disk fetch
+        (:meth:`_plan_chunk_host` — dict walk, or disk read + CRC +
+        possible rebuild), retried with backoff.  This is what the
+        pipelined prefetch workers run ahead of the apply loop (with
+        ``degrade=False`` — see :meth:`_plan_chunk_host`): the work
+        releases the GIL (h5py/numpy C code, injected-latency sleeps),
+        so it genuinely overlaps the apply thread's dispatches — the
+        device staging (:meth:`_stage_plan_chunk`) deliberately stays on
+        the apply thread, where it costs the same as in the sequential
+        schedule."""
+        def _fetch():
             faults.check("plan_upload", exc=RuntimeError, chunk=ci)
-            per = self._plan_chunk_host(ci)
-            rows = {k: [None] * self.n_devices
-                    for k in self._STREAM_ARRAYS}
-            n = 0
-            for d, pc in per.items():
-                for k in self._STREAM_ARRAYS:
-                    rows[k][d] = pc[k]
-                    n += pc[k].nbytes
-            return n, tuple(self._assemble_sharded(rows[k])
-                            for k in self._STREAM_ARRAYS)
+            return self._plan_chunk_host(ci, degrade=degrade)
 
-        n, staged = faults.with_retries("plan_upload", _stage,
-                                        exc_types=(RuntimeError,))
-        # counted AFTER the retried closure succeeds — a transient failure
-        # mid-stage must not double-count the chunk's bytes
+        return faults.with_retries("plan_upload", _fetch,
+                                   exc_types=(RuntimeError,))
+
+    def _stage_plan_chunk(self, per: dict):
+        """Fetched host arrays → the mesh ([D, ...] assembled arrays).
+        The H2D dispatch is async; the byte counter increments here —
+        AFTER the retried fetch succeeded — so a transient failure never
+        double-counts a chunk."""
+        rows = {k: [None] * self.n_devices for k in self._STREAM_ARRAYS}
+        n = 0
+        for d, pc in per.items():
+            for k in self._STREAM_ARRAYS:
+                rows[k][d] = pc[k]
+                n += pc[k].nbytes
+        staged = tuple(self._assemble_sharded(rows[k])
+                       for k in self._STREAM_ARRAYS)
         counter("bytes_h2d", path="plan_stream").inc(n)
         return staged
+
+    def _stage_with_retries(self, per: dict):
+        """Device staging under the same bounded-retry policy as the
+        fetch (the staging is idempotent pure H2D, and the byte counter
+        is the closure's LAST step, so a failed attempt never
+        double-counts) — a transient dispatch failure degrades to a
+        retry instead of killing a solve mid-apply."""
+        return faults.with_retries(
+            "plan_upload", lambda: self._stage_plan_chunk(per),
+            exc_types=(RuntimeError,))
+
+    def _upload_plan_chunk(self, ci: int):
+        """Stage one plan chunk onto the mesh ([D, ...] assembled arrays).
+        Dispatched one chunk AHEAD of the sequential apply loop so the
+        H2D copy overlaps the previous chunk's device pass (the PR-1
+        double-buffer pattern, now on the apply path).  The upload is
+        idempotent (pure H2D of host-resident arrays), so a transient
+        failure is retried with backoff instead of killing a solve
+        mid-apply."""
+        return self._stage_with_retries(self._fetch_plan_chunk(ci))
+
+    def _resolve_pipeline_depth(self, nchunks: int) -> int:
+        """Resolve the ``pipeline_depth`` knob (constructor argument >
+        ``config.pipeline`` / ``DMT_PIPELINE``) for an apply of
+        ``nchunks`` row chunks: 0 = the sequential compute-then-exchange
+        schedule every earlier round shipped (and the default), an
+        integer >= 2 = that many chunks in flight, ``auto`` = the
+        roofline-calibration policy
+        (:func:`~..obs.roofline.choose_pipeline_depth` — on only when the
+        priced overlappable time is worth the bookkeeping).  Single-
+        program plan modes (ell/compact) have no chunk sequence to
+        pipeline and always resolve 0."""
+        if self.mode not in ("fused", "streamed"):
+            return 0
+        val = self._pipeline_req
+        if val is None:
+            val = get_config().pipeline
+        s = str(val).strip().lower()
+        if s in ("", "off", "0", "1", "false", "no", "none"):
+            return 0
+        if s == "auto":
+            from ..obs import roofline as _roofline
+            depth = _roofline.choose_pipeline_depth(
+                self._phase_counts(2 if self.pair else 1),
+                _roofline.resolve_calibration(), int(nchunks),
+                self.n_devices)
+            if depth:
+                log_debug(f"pipeline auto: depth {depth} over {nchunks} "
+                          f"chunk(s) ({self.mode})")
+            return depth
+        try:
+            depth = int(s)
+        except ValueError:
+            raise ValueError(
+                f"bad pipeline depth {val!r}: pick off | auto | an "
+                "integer >= 2 (DMT_PIPELINE / config.pipeline)") from None
+        if depth < 0:
+            raise ValueError(f"pipeline depth must be >= 0, got {depth}")
+        depth = min(depth, max(int(nchunks), 1))
+        # a clamp down to one chunk leaves nothing to pipeline — resolve
+        # to the sequential schedule, not a degenerate depth-1 pipeline
+        return depth if depth >= 2 else 0
 
     def _make_streamed_matvec(self):
         D, M, T = self.n_devices, self.shard_size, self.num_terms
@@ -1993,7 +2223,16 @@ class DistributedEngine:
         n_recv = D * cap_apply
         pallas_interp = self.mesh.devices.flat[0].platform != "tpu"
 
-        def make_programs(tail):
+        def make_decode_send(tail):
+            """One chunk's SEND side as a pure function of (x slice,
+            plan arrays): decode + gather + multiply + scatter into the
+            bucketed send buffer, plus the decoded receive layout.
+            Shared by the sequential chunk program (which consumes all
+            three outputs) and the pipelined produce program (which keeps
+            only the send buffer — XLA dead-code-eliminates the receive
+            decode there; the exchange program re-derives it via
+            ``decode_recv``), so the two schedules compute identical
+            amplitudes by construction."""
             nbt = len(tail) - len(ptail)   # number of batch axes (0 or 1)
             # the explicit Pallas kernel covers the dict-coded real-sector
             # single-column stream (the bench/gate shape); every other
@@ -2004,20 +2243,16 @@ class DistributedEngine:
                           and spec["coeff"] == "dict"
                           and self.real and tail == ())
 
-            def shard_body(xp, y, start, dest, coeff, ridx, rok, cdict):
-                xp_, y_ = xp[0], y[0]
-                zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
-                x_c = jax.lax.dynamic_slice(
-                    xp_, (start,) + zeros, (B,) + tail)
+            def decode_send(x_c, dest, coeff, ridx, rok, cdict):
                 if use_pallas:
                     # fused decode+gather+multiply+scatter in one kernel;
                     # same arithmetic, so the result is bit-identical to
                     # the XLA decode path
                     ridx_ = PC.unpack_bits(
-                        ridx[0], n_recv, spec["w_ridx"]).astype(jnp.int32)
-                    rok_ = PC.unpack_bits(rok[0], n_recv, 1).astype(bool)
+                        ridx, n_recv, spec["w_ridx"]).astype(jnp.int32)
+                    rok_ = PC.unpack_bits(rok, n_recv, 1).astype(bool)
                     send_a = PC.fused_decode_gather_scatter(
-                        spec, dest[0], coeff[0], cdict[0], x_c,
+                        spec, dest, coeff, cdict, x_c,
                         interpret=pallas_interp)[:n_recv]
                 elif tier_off:
                     # raw plan layout: identical arithmetic to the fused
@@ -2025,7 +2260,7 @@ class DistributedEngine:
                     # dead/overflowed entries dropped by dest == D·Cap
                     # (coeff is pre-zeroed for dead entries)
                     dest_, cf_, ridx_, rok_ = PC.decode_plan_shard(
-                        spec, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                        spec, dest, coeff, ridx, rok, cdict)
                     x_t = x_c[:, None]
                     g_t = cf_
                     if nbt:
@@ -2046,7 +2281,7 @@ class DistributedEngine:
                     # the drop sentinel.  Values and accumulation order
                     # match the raw layout exactly (DESIGN.md §23).
                     dest_, row_, cf_, ridx_, rok_ = PC.decode_plan_shard(
-                        spec, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                        spec, dest, coeff, ridx, rok, cdict)
                     xg = x_c[row_]                     # [n_live] + tail
                     if is_pair:
                         g = cf_[:, None, :] if nbt else cf_
@@ -2057,6 +2292,52 @@ class DistributedEngine:
                     send_a = jnp.zeros((n_recv,) + tail,
                                        dtype).at[dest_].set(
                         amps, mode="drop")
+                return send_a, ridx_, rok_
+
+            return decode_send
+
+        def decode_recv(ridx, rok):
+            """The receive layout alone (the pipelined exchange program's
+            half of the decode) — same unpack ops as the send side's."""
+            rok_ = PC.unpack_bits(rok, n_recv, 1).astype(bool)
+            if tier_off:
+                return ridx, rok_
+            return PC.unpack_bits(
+                ridx, n_recv, spec["w_ridx"]).astype(jnp.int32), rok_
+
+        def accumulate(y_, recv_a, ridx_, rok_, tail):
+            """Receive-side accumulation — ONE definition for both
+            schedules, so the pipelined apply cannot drift from the
+            sequential one by construction (same mask, same
+            ``segment_sum``, same order)."""
+            return y_ + jax.ops.segment_sum(
+                jnp.where(rok_.reshape(rok_.shape + (1,) * len(tail)),
+                          recv_a, 0),
+                ridx_, num_segments=M)
+
+        def make_io_progs(tail):
+            nd = 2 + len(tail)
+            pad_prog = jax.jit(lambda x: jnp.pad(
+                x.astype(dtype),
+                ((0, 0), (0, Mp - M)) + ((0, 0),) * len(tail)))
+            zeros_prog = jax.jit(
+                lambda: jnp.zeros((D, M) + tail, dtype),
+                out_shardings=shard_spec(mesh, nd))
+            epi_prog = jax.jit(
+                lambda y, x, diag: y + diag.astype(dtype).reshape(
+                    diag.shape + (1,) * len(tail)) * x.astype(dtype))
+            return pad_prog, zeros_prog, epi_prog
+
+        def make_programs(tail):
+            decode_send = make_decode_send(tail)
+
+            def shard_body(xp, y, start, dest, coeff, ridx, rok, cdict):
+                xp_, y_ = xp[0], y[0]
+                zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
+                x_c = jax.lax.dynamic_slice(
+                    xp_, (start,) + zeros, (B,) + tail)
+                send_a, ridx_, rok_ = decode_send(
+                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
                 if D > 1:
                     recv_a = jax.lax.all_to_all(
                         send_a.reshape((D, cap_apply) + tail), SHARD_AXIS,
@@ -2064,11 +2345,7 @@ class DistributedEngine:
                     ).reshape((-1,) + tail)
                 else:
                     recv_a = send_a
-                y_ = y_ + jax.ops.segment_sum(
-                    jnp.where(rok_.reshape(rok_.shape + (1,) * len(tail)),
-                              recv_a, 0),
-                    ridx_, num_segments=M)
-                return y_[None]
+                return accumulate(y_, recv_a, ridx_, rok_, tail)[None]
 
             nd = 2 + len(tail)
 
@@ -2084,18 +2361,66 @@ class DistributedEngine:
                 return f(xp, y, start, dest, coeff, ridx, rok, cdict)
 
             chunk_prog = jax.jit(chunk_fn, donate_argnums=(1,))
-            pad_prog = jax.jit(lambda x: jnp.pad(
-                x.astype(dtype),
-                ((0, 0), (0, Mp - M)) + ((0, 0),) * len(tail)))
-            zeros_prog = jax.jit(
-                lambda: jnp.zeros((D, M) + tail, dtype),
-                out_shardings=shard_spec(mesh, nd))
-            epi_prog = jax.jit(
-                lambda y, x, diag: y + diag.astype(dtype).reshape(
-                    diag.shape + (1,) * len(tail)) * x.astype(dtype))
-            return chunk_prog, pad_prog, zeros_prog, epi_prog
+            return (chunk_prog,) + make_io_progs(tail)
+
+        def make_pipe_programs(tail):
+            """The pipelined schedule's split programs (DESIGN.md §25):
+            ``send_prog`` produces one chunk's bucketed send buffer (the
+            local gather/multiply — dispatched up to ``depth`` chunks
+            ahead), ``exch_prog`` runs the STAGED exchange (D−1
+            ``ppermute`` rounds, element-identical to the monolithic
+            ``all_to_all``) and accumulates into the donated ``y``.
+            Exchanges retire strictly in chunk order through the ``y``
+            chain, so the accumulation order — and therefore every bit of
+            the result — matches the sequential schedule."""
+            decode_send = make_decode_send(tail)
+            nd = 2 + len(tail)
+
+            def send_body(xp, start, dest, coeff, ridx, rok, cdict):
+                zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
+                x_c = jax.lax.dynamic_slice(
+                    xp[0], (start,) + zeros, (B,) + tail)
+                send_a, _, _ = decode_send(
+                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                return send_a[None]
+
+            def send_fn(xp, start, dest, coeff, ridx, rok, cdict):
+                f = shard_map_compat(
+                    send_body, mesh=mesh,
+                    in_specs=(_pspec(nd), P(),
+                              _pspec(dest.ndim), _pspec(coeff.ndim),
+                              _pspec(ridx.ndim), _pspec(rok.ndim),
+                              _pspec(cdict.ndim)),
+                    out_specs=_pspec(2 + len(tail)),
+                )
+                return f(xp, start, dest, coeff, ridx, rok, cdict)
+
+            def exch_body(y, send, ridx, rok):
+                y_, s_ = y[0], send[0]
+                ridx_, rok_ = decode_recv(ridx[0], rok[0])
+                recv_a = _staged_all_to_all(
+                    s_.reshape((D, cap_apply) + tail),
+                    SHARD_AXIS).reshape((-1,) + tail)
+                return accumulate(y_, recv_a, ridx_, rok_, tail)[None]
+
+            def exch_fn(y, send, ridx, rok):
+                f = shard_map_compat(
+                    exch_body, mesh=mesh,
+                    in_specs=(_pspec(nd), _pspec(2 + len(tail)),
+                              _pspec(ridx.ndim), _pspec(rok.ndim)),
+                    out_specs=_pspec(nd),
+                )
+                return f(y, send, ridx, rok)
+
+            # y chains through the exchanges (donated, as in the
+            # sequential program); the send buffer is donated into its
+            # exchange so slot memory really is bounded at `depth` buffers
+            send_prog = jax.jit(send_fn)
+            exch_prog = jax.jit(exch_fn, donate_argnums=(0, 1))
+            return (send_prog, exch_prog) + make_io_progs(tail)
 
         programs: dict = {}
+        pipe_programs: dict = {}
 
         def run_cols(x):
             tail = tuple(x.shape[2:])
@@ -2144,6 +2469,135 @@ class DistributedEngine:
                 self._stream_timeline.extend(timeline)
             return epi_prog(y, x, self._diag)
 
+        depth = self.pipeline_depth
+
+        def run_cols_pipe(x):
+            """The pipelined schedule (DESIGN.md §25): plan staging runs
+            up to ``depth`` chunks ahead in the prefetch workers, produce
+            programs are dispatched as their chunks stage, and each
+            chunk's staged exchange retires strictly in chunk order once
+            ``depth`` produces are queued ahead of it — so the device
+            sees P_j..P_{j+depth-1} before X_j and can drain compute
+            while an exchange is in flight.  The consume-side waits
+            (``stall_ms``) are the apply's measured time-at-barrier; the
+            worker-side staging walls (``stage_ms``) are the work the
+            pipeline hid."""
+            tail = tuple(x.shape[2:])
+            progs = pipe_programs.get(tail)
+            if progs is None:
+                progs = pipe_programs[tail] = make_pipe_programs(tail)
+            send_prog, exch_prog, pad_prog, zeros_prog, epi_prog = progs
+            xp = pad_prog(x)
+            y = zeros_prog()
+            record_stall = obs_enabled()
+            timeline = [] if obs_phases.phases_enabled() else None
+            d = max(min(depth, nchunks), 1)
+            sends: dict = {}
+            entries: dict = {}            # chunk -> its timeline entry
+
+            def retire(j, y):
+                # send-slot discipline: slot j's exchange is dispatched as
+                # soon as `depth` produces are in the queue ahead of it —
+                # the produce→exchange dependency rides the dataflow (the
+                # exchange consumes and DONATES the send buffer), so no
+                # host sync is needed and the dispatch wall stays
+                # comparable to the sequential schedule's.  At most
+                # `depth` send buffers sit between a produce and its
+                # exchange in the dispatch stream.  The dispatch wall
+                # lands on CHUNK J's timeline entry (the exchange retired
+                # here belongs to chunk j, not to the loop iteration
+                # dispatching it).
+                snd, ridx_j, rok_j = sends.pop(j)
+                _t1 = time.perf_counter()
+                y = exch_prog(y, snd, ridx_j, rok_j)
+                ent = entries.pop(j, None)
+                if ent is not None:
+                    ent["exch_ms"] = round(
+                        (time.perf_counter() - _t1) * 1e3, 4)
+                return y
+
+            pfh = {"pf": _PlanPrefetcher(self, nchunks, d)}
+
+            def consume(ci):
+                # prefetch-get (the measured barrier wait when the fetch
+                # was NOT hidden) + the H2D dispatch — called one chunk
+                # AHEAD of use, so the transfer overlaps the previous
+                # chunk's dispatches exactly as in the sequential
+                # schedule's double buffer
+                kind, val, stage_ms, wait_ms = pfh["pf"].get(ci)
+                if kind == "degrade":
+                    # corrupt-chunk repair runs HERE, on the apply thread
+                    # (it can dispatch collective build programs and
+                    # mutate plan state): stop the workers, degrade or
+                    # rebuild exactly as the sequential schedule would,
+                    # then resume prefetching the chunks still ahead
+                    pfh["pf"].close(join=True)
+                    _t0 = time.perf_counter()
+                    val = self._fetch_plan_chunk(ci)
+                    wait_ms += (time.perf_counter() - _t0) * 1e3
+                    pfh["pf"] = _PlanPrefetcher(self, nchunks, d,
+                                                start=ci + 1)
+                return self._stage_with_retries(val), stage_ms, wait_ms
+
+            try:
+                nxt = consume(0) if nchunks else None
+                for ci in range(nchunks):
+                    entry = None
+                    if timeline is not None:
+                        entry = entries[ci] = {"chunk": ci}
+                        timeline.append(entry)   # mutated through retire
+                    # chunk span: staging consume + produce dispatch (+ the
+                    # in-order retire of the chunk leaving the pipeline) —
+                    # a rank wedged here leaves the span open, so the
+                    # heartbeat's stall_report names the stuck chunk
+                    with obs_trace.span("chunk", kind="chunk", chunk=ci):
+                        staged, stage_ms, wait_ms = nxt
+                        if record_stall:
+                            # consume-side exposure: the prefetch wait +
+                            # the residual wait on a transfer dispatched
+                            # one chunk ago — ~0 when the pipeline hid the
+                            # fetch behind compute, the time-at-barrier
+                            # otherwise.  The sync exists only to feed the
+                            # metric (dispatch tracks the transfer
+                            # dependency itself), same contract as the
+                            # sequential stall probe.
+                            _t0 = time.perf_counter()
+                            jax.block_until_ready(staged)
+                            stall_ms = wait_ms \
+                                + (time.perf_counter() - _t0) * 1e3
+                            histogram("plan_stream_stall_ms").observe(
+                                stall_ms)
+                            if entry is not None:
+                                entry["stall_ms"] = round(stall_ms, 4)
+                                entry["stage_ms"] = round(stage_ms, 4)
+                        # only the exchange's operands stay referenced
+                        # until retire: dropping the dest/coeff arrays
+                        # here keeps the live plan footprint at the
+                        # documented `depth` send buffers, not `depth`
+                        # full plan chunks
+                        sends[ci] = (send_prog(xp, jnp.int32(ci * B),
+                                               *staged, self._cdict_dev),
+                                     staged[2], staged[3])
+                        if ci >= d - 1:
+                            y = retire(ci - (d - 1), y)
+                        if ci + 1 < nchunks:
+                            nxt = consume(ci + 1)
+                # drain: the last d−1 chunks' exchanges, still in order
+                for j in range(max(nchunks - d + 1, 0), nchunks):
+                    with obs_trace.span("chunk", kind="chunk", chunk=j,
+                                        drain=True):
+                        y = retire(j, y)
+            finally:
+                # join even on the exception path: a retried apply must
+                # not spawn fresh workers while an old one is still
+                # inside the thread-unsafe h5py handles
+                pfh["pf"].close(join=True)
+            if timeline is not None:
+                self._stream_timeline.extend(timeline)
+            return epi_prog(y, x, self._diag)
+
+        run_group = run_cols_pipe if depth >= 2 else run_cols
+
         def run(x):
             # WIDE batches are applied in column groups of 4: per-chunk
             # scratch (amps [B, T, k] + exchange [D·Cap·k]) grows linearly
@@ -2156,10 +2610,10 @@ class DistributedEngine:
             k = x.shape[2] if x.ndim == 3 + tl else 1
             if k > 4:
                 y = jnp.concatenate(
-                    [run_cols(x[:, :, s:s + 4])
+                    [run_group(x[:, :, s:s + 4])
                      for s in range(0, k, 4)], axis=2)
             else:
-                y = run_cols(x)
+                y = run_group(x)
             self._last_program_key = "streamed"
             self._last_capacity = Cap
             return (y, jnp.asarray(self._stream_overflow, jnp.int64),
@@ -2375,6 +2829,13 @@ class DistributedEngine:
         is_pair = self.pair
         ptail = (2,) if is_pair else ()   # trailing (re, im) axis in pair mode
         mesh = self.mesh
+        # the fused pipeline is the IN-PROGRAM software pipeline: one
+        # chunk's staged exchange in flight under the next chunk's
+        # compute, i.e. depth 2 regardless of the requested number (extra
+        # depth only means extra live send buffers inside one program —
+        # report the honest value)
+        self.pipeline_depth = min(self.pipeline_depth, 2)
+        pipe = self.pipeline_depth >= 2
 
         def make_program(B, Cap):
             nchunks = M // B if M % B == 0 else M // B + 1
@@ -2396,9 +2857,11 @@ class DistributedEngine:
                 np_ = jnp.pad(norms, (0, Mp - M), constant_values=1.0)
                 nbt = len(tail) - len(ptail)  # number of batch axes (0 or 1)
 
-                def chunk(carry, args):
-                    y, overflow, invalid = carry
-                    a_c, n_c, x_c = args
+                def produce(a_c, n_c, x_c):
+                    """Chunk SEND side: orbit scan + amplitudes + bucket
+                    routing into the fixed-capacity send buffers (plus the
+                    overflow delta) — shared by the sequential and
+                    pipelined scan bodies, so both route identically."""
                     betas, gcoeff = K.gather_coefficients(tables, a_c, n_c)
                     # scatter-form amplitude: conj(row form) · x[α].  Liveness is
                     # *structural* (coeff ≠ 0, row not padding) — independent of
@@ -2435,22 +2898,19 @@ class DistributedEngine:
                     # this exact routing once and stores the result.
                     pos = _bucket_positions(key, D)
                     in_cap = (pos < Cap) & (key < D)
-                    overflow = overflow + jnp.sum((pos >= Cap) & (key < D))
+                    ov = jnp.sum((pos >= Cap) & (key < D))
                     dest = jnp.where(in_cap, key * Cap + pos, D * Cap)
                     send_b = jnp.full(D * Cap, SENTINEL_STATE).at[dest].set(
                         flat_b, mode="drop")
                     send_a = jnp.zeros((D * Cap,) + tail, dtype).at[dest].set(
                         flat_a, mode="drop")
-                    if D > 1:
-                        recv_b = jax.lax.all_to_all(
-                            send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
-                        ).reshape(-1)
-                        recv_a = jax.lax.all_to_all(
-                            send_a.reshape((D, Cap) + tail), SHARD_AXIS, 0, 0,
-                            tiled=True
-                        ).reshape((-1,) + tail)
-                    else:
-                        recv_b, recv_a = send_b, send_a
+                    return send_b, send_a, ov
+
+                def consume(y, invalid, recv_b, recv_a):
+                    """Chunk RECEIVE side: owner lookup + masked
+                    ``segment_sum`` — one definition for both schedules
+                    (the pipelined body feeds it the same values one scan
+                    step later, so accumulation order is unchanged)."""
                     idx, found = state_index_bucketed(
                         lk_pair, lk_dir, recv_b,
                         shift=lk_shift, probes=lk_probes)
@@ -2464,18 +2924,86 @@ class DistributedEngine:
                                   recv_a, 0),
                         jnp.where(okc, idx, 0),
                         num_segments=M)
+                    return y, invalid
+
+                def chunk(carry, args):
+                    y, overflow, invalid = carry
+                    a_c, n_c, x_c = args
+                    send_b, send_a, ov = produce(a_c, n_c, x_c)
+                    overflow = overflow + ov
+                    if D > 1:
+                        recv_b = jax.lax.all_to_all(
+                            send_b.reshape(D, Cap), SHARD_AXIS, 0, 0, tiled=True
+                        ).reshape(-1)
+                        recv_a = jax.lax.all_to_all(
+                            send_a.reshape((D, Cap) + tail), SHARD_AXIS, 0, 0,
+                            tiled=True
+                        ).reshape((-1,) + tail)
+                    else:
+                        recv_b, recv_a = send_b, send_a
+                    y, invalid = consume(y, invalid, recv_b, recv_a)
                     return (y, overflow, invalid), None
 
-                init = pcast_varying(
-                    (jnp.zeros((M,) + tail, dtype), jnp.zeros((), jnp.int64),
-                     jnp.zeros((), jnp.int64)),
-                    SHARD_AXIS,
-                )
-                (y, overflow, invalid), _ = jax.lax.scan(
-                    chunk, init,
-                    (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
-                     xp.reshape((nchunks, B) + tail).astype(dtype)),
-                )
+                def exchange_staged(send_b, send_a):
+                    recv_b = _staged_all_to_all(
+                        send_b.reshape(D, Cap), SHARD_AXIS).reshape(-1)
+                    recv_a = _staged_all_to_all(
+                        send_a.reshape((D, Cap) + tail),
+                        SHARD_AXIS).reshape((-1,) + tail)
+                    return recv_b, recv_a
+
+                def chunk_pipe(carry, args):
+                    # the in-program software pipeline (DESIGN.md §25):
+                    # the PREVIOUS chunk's staged exchange + accumulate
+                    # and THIS chunk's orbit scan/routing are independent
+                    # dataflow inside one scan step, so the scheduler may
+                    # run the ppermute rounds while the gather/multiply
+                    # computes — chunk i's exchange in flight under chunk
+                    # i+1's compute, exactly the overlap the roofline's
+                    # pipelined estimate prices.  y still accumulates in
+                    # chunk order (one step late), so the result is
+                    # bit-identical to the sequential schedule.  The
+                    # carry grows by the 2·D·Cap in-flight send buffers —
+                    # small next to the B·T orbit-scan working set
+                    # (measured ~1% on the CPU rig, whose runtime copies
+                    # scan carries per iteration; pipeline-check bounds
+                    # the ratio), and the price of keeping this ONE
+                    # static program.
+                    y, overflow, invalid, prev_b, prev_a = carry
+                    a_c, n_c, x_c = args
+                    recv_b, recv_a = exchange_staged(prev_b, prev_a)
+                    y, invalid = consume(y, invalid, recv_b, recv_a)
+                    send_b, send_a, ov = produce(a_c, n_c, x_c)
+                    return (y, overflow + ov, invalid, send_b, send_a), None
+
+                xs = (ap.reshape(nchunks, B), np_.reshape(nchunks, B),
+                      xp.reshape((nchunks, B) + tail).astype(dtype))
+                if not pipe:
+                    init = pcast_varying(
+                        (jnp.zeros((M,) + tail, dtype),
+                         jnp.zeros((), jnp.int64),
+                         jnp.zeros((), jnp.int64)),
+                        SHARD_AXIS,
+                    )
+                    (y, overflow, invalid), _ = jax.lax.scan(chunk, init, xs)
+                else:
+                    # prologue slot: an all-SENTINEL/zero in-flight chunk —
+                    # its receive side is fully masked, so consuming it
+                    # adds exact zeros to the all-+0.0 initial y (no bit
+                    # can change) and counts nothing
+                    init = pcast_varying(
+                        (jnp.zeros((M,) + tail, dtype),
+                         jnp.zeros((), jnp.int64),
+                         jnp.zeros((), jnp.int64),
+                         jnp.full(D * Cap, SENTINEL_STATE),
+                         jnp.zeros((D * Cap,) + tail, dtype)),
+                        SHARD_AXIS,
+                    )
+                    (y, overflow, invalid, last_b, last_a), _ = \
+                        jax.lax.scan(chunk_pipe, init, xs)
+                    # epilogue: the last chunk's exchange drains here
+                    recv_b, recv_a = exchange_staged(last_b, last_a)
+                    y, invalid = consume(y, invalid, recv_b, recv_a)
                 # cross-shard totals so every shard reports the same counters
                 overflow = jax.lax.psum(overflow, SHARD_AXIS)
                 invalid = jax.lax.psum(invalid, SHARD_AXIS)
@@ -2741,18 +3269,47 @@ class DistributedEngine:
                 for s in xh.shape[2:]:
                     tail_elems *= int(s)
                 k = tail_elems // 2 if self.pair else tail_elems
-                timeline = measured = None
+                timeline = measured = pipe = None
                 if self.mode == "streamed":
                     timeline = self._stream_timeline or None
                     self._stream_timeline = []
                     if timeline:
                         measured = {"plan_h2d": sum(
                             c.get("stall_ms", 0.0) for c in timeline)}
+                if self.pipeline_depth:
+                    # the measured overlap/time-at-barrier split of a
+                    # pipelined apply (DESIGN.md §25): barrier_ms = host
+                    # wall EXPOSED waiting on plan staging (the consume
+                    # waits), hidden_ms = staging work the prefetch
+                    # workers ran behind chunk compute, overlap_fraction =
+                    # the hidden share.  The exchange programs' dispatch
+                    # walls ride as the measured `exchange` phase — an
+                    # exchange beating its bound renders `hidden` in the
+                    # roofline report, i.e. overlap working (§22).
+                    pipe = {"depth": int(self.pipeline_depth)}
+                    if timeline:
+                        barrier = sum(c.get("stall_ms", 0.0)
+                                      for c in timeline)
+                        # a chunk's hidden work is the part of its fetch
+                        # wall the consumer did NOT wait out — a fully
+                        # exposed fetch (stall ≈ stage) hid nothing, and
+                        # must not report overlap_fraction ≈ 0.5
+                        hidden = sum(max(c.get("stage_ms", 0.0)
+                                         - c.get("stall_ms", 0.0), 0.0)
+                                     for c in timeline)
+                        measured["exchange"] = sum(
+                            c.get("exch_ms", 0.0) for c in timeline)
+                        pipe.update(
+                            barrier_ms=barrier, hidden_ms=hidden,
+                            overlap_fraction=(
+                                max(0.0, min(1.0,
+                                             hidden / (hidden + barrier)))
+                                if hidden + barrier > 0 else None))
                 obs_phases.emit_apply_phases(
                     "distributed", self.mode, idx, dt_ms,
                     self._phase_counts(tail_elems), chunks=self._nchunks(),
                     columns=max(k, 1), measured_ms=measured,
-                    chunk_timeline=timeline)
+                    chunk_timeline=timeline, pipeline=pipe)
         histogram("matvec_apply_ms", engine="distributed").observe(dt_ms)
         return y
 

@@ -97,6 +97,20 @@ class RuntimeConfig:
     #   don't repeat enough to dictionary-code).  The plan sidecar, the
     #   host-RAM copy, and the per-apply H2D stream all carry the ENCODED
     #   bytes; decode happens on device inside the chunk program
+    pipeline: str = "off"                  # pipelined distributed applies
+    #   (DMT_PIPELINE, DESIGN.md §25): software-pipeline depth for the
+    #   fused/streamed DistributedEngine apply — "off" (sequential
+    #   compute-then-exchange per chunk, bit-identical to every earlier
+    #   round), an integer >= 2 (streamed: that many chunks in flight —
+    #   plan staging prefetched by worker threads, produce/exchange split
+    #   programs with bounded send slots, exchange decomposed into
+    #   ppermute rounds; fused: the in-program software pipeline —
+    #   chunk i's staged exchange overlaps chunk i+1's gather/multiply
+    #   inside one lax.scan), or "auto" (consult the roofline
+    #   calibration: on when the priced overlappable time is worth it,
+    #   obs/roofline.choose_pipeline_depth).  Accumulation order is
+    #   UNCHANGED at any depth, so pipelined applies stay bit-identical
+    #   to sequential ones (gated by `make pipeline-check`)
     stream_kernel: str = "auto"            # compressed-chunk decode path
     #   (DMT_STREAM_KERNEL): "auto" (currently = xla), "xla" (decode ops
     #   traced into the chunk program — XLA fuses unpack+gather+multiply+

@@ -85,6 +85,47 @@ if os.environ.get("DMT_MH_TRACE"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_PIPE") is not None:
+    # Pipelined-apply leg for the barrier gate (tools/pipeline_check.py
+    # and tests/test_engine_pipelined.py): a streamed engine per rank
+    # over a RANK-LOCAL mesh (the CPU backend cannot run cross-process
+    # computations — same constraint as the legs below), applied
+    # repeatedly with a deterministic per-chunk staging latency injected
+    # on rank 1 only (the parent arms DMT_FAULT=plan_upload:delay=...) —
+    # the reproducible straggler.  Sequential applies pay that latency
+    # INLINE, so rank 1's matvec_apply events lag further behind rank 0
+    # every apply and `obs_report report --ranks` reads a growing
+    # time-at-barrier; a pipeline_depth>=2 run stages the same chunks in
+    # the prefetch workers, hides the same injected latency behind chunk
+    # compute, and the barrier wait collapses — the >=2x cut the
+    # acceptance gate asserts.  Correctness still asserted so a broken
+    # pipeline cannot masquerade as a latency win.
+    import time as _time
+
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+
+    depth = int(os.environ["DMT_MH_PIPE"])      # 0 = sequential leg
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="streamed", batch_size=32,
+                            pipeline_depth=depth)
+    xh = eng.to_hashed(x)
+    yh = eng.matvec(xh)                 # warm-up: compile + first stream
+    jax.block_until_ready(yh)
+    napply = int(os.environ.get("DMT_MH_PIPE_APPLIES", "8"))
+    t0 = _time.perf_counter()
+    for _ in range(napply):
+        yh = eng.matvec(xh)
+    jax.block_until_ready(yh)
+    steady_ms = (_time.perf_counter() - t0) / napply * 1e3
+    err = float(np.abs(eng.from_hashed(yh) - want).max())
+    print(f"[p{pid}] pipe depth={depth}: steady {steady_ms:.3f} ms/apply, "
+          f"max err {err:.3e}", flush=True)
+    assert err < 1e-12, err
+    print(f"[p{pid}] PIPE_STEADY_MS {steady_ms:.4f}", flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

@@ -57,6 +57,8 @@ METRIC_WHITELIST = (
     "plan_stream_stall_ms", "apply_wall_ms", "speedup_vs_numpy",
     "plan_bytes_encoded", "compress_ratio", "compressed_steady_apply_ms",
     "compress_steady_speedup", "compress_rel_err", "compress_drift_max",
+    "pipelined_steady_apply_ms", "pipelined_steady_speedup",
+    "barrier_ms", "overlap_fraction", "pipeline_depth",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -68,11 +70,23 @@ METRIC_WHITELIST = (
 #: rule) guards the lossy tiers' NUMERICS: quantized coefficients whose
 #: error quietly grows fail the gate even when wall clocks and ratios
 #: hold.  Lossless runs record 0.0, which the gate skips as a baseline —
-#: the pair only arms on quantized-tier records.
+#: the pair only arms on quantized-tier records.  The pipelined pair
+#: (``barrier_ms`` time-at-barrier, ``pipelined_steady_apply_ms`` wall —
+#: both cost-like under obs_report's direction rule) guards the overlap
+#: win: a PR that quietly re-exposes the staging latency the pipeline
+#: hides fails the gate even when the sequential walls hold.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s", "compress_rel_err",
-                "compress_drift_max")
+                "compress_drift_max", "barrier_ms",
+                "pipelined_steady_apply_ms")
+
+#: Absolute noise floors per gated metric: a baseline below the floor is
+#: scheduler jitter, not a trajectory (``barrier_ms`` on a healthy
+#: pipeline is sub-millisecond, where a 30% relative bound would gate
+#: pure noise against the all-time best) — such series are skipped, the
+#: same way exactly-zero baselines are.
+GATE_MIN_BASELINE = {"barrier_ms": 1.0}
 
 
 def _keep(metric: str) -> bool:
@@ -203,6 +217,8 @@ def gate(records: List[dict], threshold: float,
             b = max(cand) if hib else min(cand)
             if not b:
                 continue
+            if abs(b) < GATE_MIN_BASELINE.get(metric, 0.0):
+                continue     # below the metric's noise floor: not a trend
             rel = (float(nv) - b) / abs(b)
             worse = -rel if hib else rel
             rows.append((cfg, metric, b, float(nv), rel))

@@ -128,6 +128,12 @@ STREAM_COMPRESS_SETTINGS = ("off", "lossless", "f32", "bf16")
 #: chains.  A documented model constant — measured calibration wins.
 LIVE_FRACTION = 0.55
 
+#: Row-chunk size assumed when pricing the pipelined streamed tier (the
+#: engine's ``matvec_batch_size`` default): the pipelined estimate's
+#: ``1 − 1/nchunks`` factor needs a chunk count, and the planner has no
+#: engine in hand.
+PIPELINE_CHUNK_ROWS = 1 << 16
+
 
 def stream_plan_bytes_per_row(num_terms: int, pair: bool,
                               compress: str = "off") -> float:
@@ -276,9 +282,34 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
                 entry["est_apply_ms"] = round(
                     rows_share * per / g * 1e3, 3)
             elif rates.get("h2d_bytes_per_s"):
-                entry["est_apply_ms"] = round(
-                    rows_share * plan_row
-                    / float(rates["h2d_bytes_per_s"]) * 1e3, 3)
+                h2d_ms = rows_share * plan_row \
+                    / float(rates["h2d_bytes_per_s"]) * 1e3
+                entry["est_apply_ms"] = round(h2d_ms, 3)
+                # pipelined streamed tier (DESIGN.md §25): price the
+                # whole apply wall (plan stream + chunk compute +
+                # amplitude exchange at the calibrated rates), then take
+                # back the roofline's overlap term
+                # min(compute, exchange+stream)·(1 − 1/nchunks) — what a
+                # pipeline_depth >= 2 apply is priced to cost, so the
+                # recommendation can prefer it
+                if rates.get("flops_per_s") \
+                        and rates.get("exchange_bytes_per_s"):
+                    live = LIVE_FRACTION \
+                        if stream_compress not in (None, "", "off") else 1.0
+                    ent_rows = rows_share * num_terms * live
+                    compute_ms = ent_rows * 2 \
+                        / float(rates["flops_per_s"]) * 1e3
+                    exch_ms = (ent_rows * 8
+                               / float(rates["exchange_bytes_per_s"]) * 1e3
+                               if n_devices > 1 else 0.0)
+                    nch = max(int(math.ceil(
+                        rows_share / PIPELINE_CHUNK_ROWS)), 1)
+                    wall = h2d_ms + compute_ms + exch_ms
+                    overlap = (min(compute_ms, exch_ms + h2d_ms)
+                               * (1.0 - 1.0 / nch)) if nch > 1 else 0.0
+                    entry["est_apply_ms_pipelined"] = round(
+                        max(wall - overlap, 0.0), 3)
+                    entry["pipeline_nchunks_assumed"] = nch
         entry.update({
             "max_rows_per_device": rows_dev,
             "max_basis_size": rows_dev * n_devices,
@@ -296,7 +327,14 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
     preference order matches measured apply speed — streamed beats fused
     whenever its plan fits the RAM/disk budget, because steady applies
     skip the whole orbit scan) that fits within the given mesh, else the
-    minimal shard count per mode."""
+    minimal shard count per mode.  With a rate calibration in hand the
+    fitting modes are instead ranked by their ``est_apply_ms`` floors
+    (homogeneous single-resource bounds — ranking a full-wall estimate
+    against another mode's floor would bias the choice); when the winner
+    is ``streamed`` and the pipelined tier is priced, the recommendation
+    says to run it with ``pipeline_depth=auto`` (the pipelined wall beats
+    the sequential streamed wall by construction whenever there is more
+    than one chunk)."""
     n = int(target_n or report["inputs"]["n_states"])
     D = report["inputs"]["n_devices"]
     rec = {"target_n": n}
@@ -311,8 +349,25 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
                if need is not None and need <= D]
     if fitting:
         rec["recommended_mode"], rec["recommended_devices"] = fitting[0]
+        pipelined_won = False
+        ests = {mode: report["modes"][mode].get("est_apply_ms")
+                for mode, _need in fitting}
+        if all(e is not None for e in ests.values()):
+            best = min(fitting, key=lambda o: ests[o[0]])
+            rec["recommended_mode"], rec["recommended_devices"] = best
+            rec["est_apply_ms"] = ests[best[0]]
+            pipe_est = report["modes"]["streamed"].get(
+                "est_apply_ms_pipelined")
+            if best[0] == "streamed" and pipe_est is not None:
+                pipelined_won = True
+                rec["est_apply_ms_pipelined"] = pipe_est
         rec["note"] = (f"{rec['recommended_mode']} fits {n:,} rows on "
-                       f"{rec['recommended_devices']} of {D} device(s)")
+                       f"{rec['recommended_devices']} of {D} device(s)"
+                       + (" (priced pipelined: run with "
+                          "pipeline_depth=auto / DMT_PIPELINE=auto)"
+                          if pipelined_won else ""))
+        if pipelined_won:
+            rec["recommended_pipeline"] = "auto"
     else:
         mode, need = min((o for o in options if o[1] is not None),
                          key=lambda o: o[1], default=(None, None))
@@ -361,6 +416,12 @@ def print_report(report: dict, rec: dict) -> None:
             by = m["host_plan_bytes_per_row_by_compress"]
             print("            host plan B/row by stream_compress: "
                   + "  ".join(f"{s}={by[s]:.0f}" for s in by))
+        if "est_apply_ms_pipelined" in m:
+            print(f"            pipelined (depth>=2, "
+                  f"~{m['pipeline_nchunks_assumed']} chunks): est "
+                  f"{m['est_apply_ms_pipelined']:,.1f} ms/apply "
+                  f"(wall minus min(compute, exchange+stream)"
+                  f"·(1-1/n))")
     print(f"  recommendation: {rec['note']}")
 
 

@@ -52,6 +52,12 @@ Subcommands::
         bound times at the calibrated rates, achieved-vs-bound fractions,
         the named binding resource, and the pipelined-apply speedup
         estimate (the ROADMAP's overlap item, priced before it's built).
+        Runs that recorded PIPELINED applies (``pipeline_depth`` >= 2,
+        DESIGN.md §25) get their own per-depth group with the measured
+        time-at-barrier / hidden-staging split, and — when the same run
+        also holds sequential applies of that (engine, mode) — the
+        measured-vs-priced speedup side by side, with a WARNING when the
+        measured overlap falls below 50% of the estimate.
         Calibration: explicit ``--calibration`` JSON > the
         content-addressed sidecar ``tools/gather_bound.py`` persists >
         the documented DESIGN.md §2 defaults.
@@ -114,7 +120,7 @@ from typing import Dict, List, Optional
 # (compress_rel_err, compress_drift_max): numerical error growing is the
 # regression, so they gate correctly under the default rule.
 _HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate",
-                     "compress_ratio")
+                     "compress_ratio", "overlap_fraction")
 
 _DEFAULT_GATE = ("device_ms",)
 

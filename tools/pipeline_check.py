@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""pipeline-check — CI gate for pipelined applies (`make pipeline-check`).
+
+Asserts, on the CPU rig:
+
+1. **Bit-identity** — pipelined applies (DESIGN.md §25) equal sequential
+   ones bit-for-bit, fused AND streamed, single vector AND a k=3 batch:
+   the staged ``ppermute`` exchange reassembles the monolithic
+   ``all_to_all`` layout exactly and exchanges retire in chunk order, so
+   no accumulation reorders.  The structural overflow/invalid counters
+   are preserved.
+2. **Barrier cut >= 2x on the 2-process rig** — two REAL 2-process runs
+   (tests/multihost_worker.py, DMT_MH_PIPE leg) with a deterministic
+   8 ms/chunk staging latency injected on rank 1 only
+   (DMT_FAULT=plan_upload:delay=...): the sequential run pays it inline
+   and `obs_report report --ranks` reads the skew as time-at-barrier;
+   the pipeline_depth=4 run hides the same latency in its prefetch
+   workers and the measured barrier wait must drop >= 2x, with the
+   straggling rank's steady applies faster too.
+3. **Estimate-vs-measured reconciliation <= 25%** — the roofline's
+   pipelined-apply estimate (PR 7, priced off the SEQUENTIAL run's
+   phases) against the measured pipelined wall of the same engine in the
+   same process, via the `obs_report roofline` measured-vs-priced
+   side-by-side (retried: wall-clock noise on a shared host resolves by
+   attempt 3).
+4. **Trend gate fires on a synthetic barrier regression** — a
+   bench_trend record carrying `barrier_ms`/`pipelined_steady_apply_ms`
+   passes against an identical baseline, and a 20x barrier regression
+   FAILS the gate (direction-aware, cost-like).
+"""
+
+import os
+import subprocess
+import sys
+
+# platform pins BEFORE any jax import (same discipline as the siblings)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "true"
+for var in ("DMT_PIPELINE", "DMT_OBS", "DMT_OBS_DIR", "DMT_FAULT",
+            "DMT_PHASES"):
+    os.environ.pop(var, None)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+RECONCILE_TOL = 0.25
+BARRIER_CUT = 2.0
+#: injected per-chunk staging latency (ms) for the 2-proc rig's
+#: deterministic straggler — large against the rig's sub-ms chunk
+#: compute, so the sequential exposure dwarfs shared-host timing noise
+INJECT_DELAY_MS = 8
+
+
+def _spawn_two_proc(scratch: str, leg: str, depth: int) -> dict:
+    """One 2-process DMT_MH_PIPE run; returns {run_dir, steady_ms_by_rank}."""
+    import re
+    import socket
+
+    worker = os.path.join(_REPO, "tests", "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    run = os.path.join(scratch, f"run_{leg}")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_PIPE"] = str(depth)
+    env["DMT_OBS_DIR"] = run
+    # the deterministic straggler: rank 1 pays INJECT_DELAY_MS on every
+    # plan-chunk staging, both legs identically armed
+    env["DMT_FAULT"] = (f"plan_upload:delay={INJECT_DELAY_MS}"
+                        f":n=1000000:rank=1")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    steady = {}
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"{leg} worker {pid} rc={p.returncode}:\n{out[-2000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
+        m = re.search(rf"\[p{pid}\] PIPE_STEADY_MS ([0-9.]+)", out)
+        assert m, out[-2000:]
+        steady[pid] = float(m.group(1))
+    return {"run": run, "steady": steady}
+
+
+def main() -> int:
+    import tempfile
+    import time
+
+    scratch = tempfile.mkdtemp(prefix="dmt_pipeline_check_")
+    os.environ["DMT_ARTIFACT_CACHE"] = "off"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.basis import SpinBasis
+    from distributed_matvec_tpu.models.yaml_io import operator_from_dict
+    from distributed_matvec_tpu.obs import roofline as R
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+
+    ns = 12
+    basis = SpinBasis(number_spins=ns, hamming_weight=ns // 2)
+    basis.build()
+    op = operator_from_dict({"terms": [{
+        "expression": "σˣ₀ σˣ₁ + σʸ₀ σʸ₁ + σᶻ₀ σᶻ₁",
+        "sites": [[i, (i + 1) % ns] for i in range(ns)]}]}, basis)
+    n = basis.number_states
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(n)
+    X3 = rng.standard_normal((n, 3))
+    print(f"[pipeline-check] chain_{ns}: N={n}, 2 shards")
+
+    # -- 1. bit-identity + counters, fused and streamed --------------------
+    for mode in ("fused", "streamed"):
+        seq = DistributedEngine(op, n_devices=2, mode=mode, batch_size=64,
+                                pipeline_depth=0)
+        pipe = DistributedEngine(op, n_devices=2, mode=mode, batch_size=64,
+                                 pipeline_depth=4)
+        assert pipe.pipeline_depth >= 2, pipe.pipeline_depth
+        for xv in (x, X3):
+            ys = np.asarray(seq.matvec(seq.to_hashed(xv)))
+            yp = np.asarray(pipe.matvec(pipe.to_hashed(xv)))
+            assert np.array_equal(ys, yp), \
+                (f"{mode} pipelined apply is not bit-identical "
+                 f"(k={1 if xv.ndim == 1 else xv.shape[1]})")
+        if mode == "streamed":
+            assert pipe._stream_overflow == seq._stream_overflow
+            assert pipe._stream_invalid == seq._stream_invalid
+        if mode == "fused":
+            # the fused pipeline carries its in-flight send buffers in the
+            # scan carry (which the CPU runtime copies per iteration —
+            # measured ~1% here): bound the ratio so a catastrophic
+            # carry-copy regression cannot ship silently
+            xf = seq.to_hashed(x)
+            xfp = pipe.to_hashed(x)
+            best = None
+            for _ in range(3):       # shared-host noise: best of 3
+                t0 = time.perf_counter()
+                for _ in range(4):
+                    ys_ = seq.matvec(xf)
+                jax.block_until_ready(ys_)
+                t_seq = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _ in range(4):
+                    yp_ = pipe.matvec(xfp)
+                jax.block_until_ready(yp_)
+                ratio = (time.perf_counter() - t0) / max(t_seq, 1e-9)
+                best = ratio if best is None else min(best, ratio)
+            assert best <= 2.0, \
+                f"fused pipelined applies {best:.2f}x slower than sequential"
+            print(f"[pipeline-check] fused pipelined wall {best:.2f}x "
+                  "sequential (<= 2.0x bound)")
+        print(f"[pipeline-check] {mode}: pipelined == sequential "
+              "bit-for-bit (single + k=3), counters preserved")
+
+    # -- 3. estimate-vs-measured reconciliation (in-process, retried) ------
+    # (runs before the slow 2-proc leg so a reconciliation bug fails fast;
+    # batch 128 → a 4-chunk stream: genuinely pipelined, while the
+    # per-chunk dispatch overhead of the split programs stays inside the
+    # tolerance on ~3 ms chunks)
+    seq = DistributedEngine(op, n_devices=2, mode="streamed",
+                            batch_size=128, pipeline_depth=0)
+    pipe = DistributedEngine(op, n_devices=2, mode="streamed",
+                             batch_size=128, pipeline_depth=4)
+    xs, xp_ = seq.to_hashed(x), pipe.to_hashed(x)
+    jax.block_until_ready(seq.matvec(xs))      # compile/warm both
+    jax.block_until_ready(pipe.matvec(xp_))
+    err = None
+    for attempt in range(3):
+        obs.reset()
+        for _ in range(6):
+            yh = seq.matvec(xs)
+        jax.block_until_ready(yh)
+        for _ in range(6):
+            yh = pipe.matvec(xp_)
+        jax.block_until_ready(yh)
+        report = R.roofline_report(obs.events("apply_phases"),
+                                   R.default_calibration("cpu"))
+        base = report["groups"].get("distributed/streamed")
+        pgrp = report["groups"].get("distributed/streamed+pipe4")
+        assert base and pgrp, sorted(report["groups"])
+        assert pgrp.get("measured_speedup") is not None
+        assert pgrp.get("barrier_ms") is not None
+        priced_wall = max(float(base["wall_ms"])
+                          - float(base["pipelined_overlap_ms"]), 1e-9)
+        measured_wall = float(pgrp["wall_ms"])
+        err = abs(measured_wall - priced_wall) / priced_wall
+        if err <= RECONCILE_TOL:
+            break
+        print(f"[pipeline-check] reconciliation attempt {attempt + 1}: "
+              f"{err:.1%} > {RECONCILE_TOL:.0%}; retrying (timing noise "
+              "vs a genuine drift resolves by attempt 3)")
+    assert err is not None and err <= RECONCILE_TOL, \
+        (f"PR-7 estimate priced the pipelined wall at {priced_wall:.3f} ms, "
+         f"measured {measured_wall:.3f} ms ({err:.1%} > "
+         f"{RECONCILE_TOL:.0%})")
+    print(f"[pipeline-check] estimate-vs-measured: priced "
+          f"{priced_wall:.3f} ms vs measured {measured_wall:.3f} ms "
+          f"({err:.1%} <= {RECONCILE_TOL:.0%}); measured overlap "
+          f"{pgrp.get('overlap_fraction')}")
+
+    # -- 2. 2-proc rig: time-at-barrier cut >= 2x ---------------------------
+    import obs_report as rep
+
+    t0 = time.perf_counter()
+    runs = {}
+    for leg, depth in (("seq", 0), ("pipe", 4)):
+        runs[leg] = _spawn_two_proc(scratch, leg, depth)
+    waits = {}
+    for leg, info in runs.items():
+        table = rep.rank_table(rep.load_events(info["run"]))
+        rows = {row["rank"]: row for row in table["rows"]}
+        # rank 0 is the one kept waiting by the injected rank-1 straggler
+        waits[leg] = float(rows[0]["barrier_wait_ms"] or 0.0)
+    cut = waits["seq"] / max(waits["pipe"], 1e-9)
+    print(f"[pipeline-check] 2-proc rig ({time.perf_counter() - t0:.0f}s): "
+          f"time-at-barrier rank0 {waits['seq']:.2f} -> "
+          f"{waits['pipe']:.2f} ms/apply ({cut:.1f}x cut); steady "
+          f"rank1 {runs['seq']['steady'][1]:.2f} -> "
+          f"{runs['pipe']['steady'][1]:.2f} ms/apply")
+    assert cut >= BARRIER_CUT, \
+        (f"pipelined time-at-barrier cut {cut:.2f}x < {BARRIER_CUT}x "
+         f"(seq {waits['seq']:.3f} ms, pipe {waits['pipe']:.3f} ms)")
+    # the straggling rank's applies must get FASTER, not just its peers'
+    # waits shorter — the hidden staging latency is the win itself
+    assert runs["pipe"]["steady"][1] <= runs["seq"]["steady"][1], \
+        (runs["pipe"]["steady"], runs["seq"]["steady"])
+
+    # -- 4. trend gate fires on a synthetic barrier regression -------------
+    import bench_trend
+
+    progress = os.path.join(scratch, "PROGRESS.jsonl")
+    detail = {"gate_cfg": {
+        "config": "pipeline_gate", "n_states": int(n),
+        # clamped above bench_trend's barrier_ms noise floor so the
+        # synthetic-regression leg below always has a gateable baseline
+        "barrier_ms": round(max(waits["pipe"], 2.0), 4),
+        "pipelined_steady_apply_ms":
+            round(runs["pipe"]["steady"][1], 3)}}
+    for _ in range(2):     # baseline + current, same measurement
+        assert bench_trend.append_record(
+            progress,
+            bench_trend.compact_record(detail, "pipeline-check", "cpu"))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bench_trend.py"),
+         "gate", "--progress", progress])
+    assert r.returncode == 0, "trend gate failed on an identical record"
+    bad = {"gate_cfg": dict(detail["gate_cfg"],
+                            barrier_ms=waits["pipe"] * 20 + 10)}
+    bench_trend.append_record(
+        progress, bench_trend.compact_record(bad, "pipeline-check", "cpu"))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bench_trend.py"),
+         "gate", "--progress", progress], capture_output=True, text=True)
+    assert r.returncode == 1, \
+        f"trend gate missed a 20x barrier regression: {r.stdout}"
+    print("[pipeline-check] trend gate: passes on appended record, fires "
+          "on a synthetic barrier regression")
+
+    print("[pipeline-check] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
