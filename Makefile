@@ -13,7 +13,8 @@ HEALTH_THRESHOLD ?= 0.02
 
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
-	roofline-check compress-check trace-check pipeline-check clean
+	roofline-check compress-check trace-check pipeline-check \
+	serve-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -25,6 +26,7 @@ check:
 	$(MAKE) roofline-check
 	$(MAKE) pipeline-check
 	$(MAKE) trace-check
+	$(MAKE) serve-check
 	$(MAKE) fault-check
 
 check-fast:
@@ -134,6 +136,19 @@ pipeline-check:
 # from it.  Deterministic, ~60 s on the CPU rig.
 trace-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/trace_check.py
+
+# Solve-service gate (tools/serve_check.py): a scripted bench.py --serve
+# load-gen leg (8 mixed jobs, 3 bases) asserting per-job eigenvalues
+# match sequential solo runs at rtol 1e-12, measured engine-pool sharing
+# (builds < jobs), batched throughput beating solo (retried for timing
+# noise), the obs_report watch queue panel rendering; a SIGTERM drain of
+# a spool-backed apps/solve_service.py slowed via DMT_FAULT
+# (exit 75, in-flight jobs respooled as queued, relaunch drains them —
+# the job-level PR 6 checkpoint contract); and the bench_trend gate
+# passing on the recorded serve metrics then FIRING on a synthetic 10x
+# throughput/latency regression.  Deterministic seeds, ~90 s on CPU.
+serve-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/serve_check.py
 
 # Chaos gate (tools/fault_check.py): the ROADMAP's resumed-run
 # bit-consistency acceptance as a repeatable gate — kill a 2-device solve

@@ -46,7 +46,15 @@ def main(argv=None):
 
 
 def _main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit codes: 0 solved, 2 bad config/arguments, "
+               "75 preempted (SIGTERM/SIGINT latched; a checkpoint was "
+               "written at the last safe point — relaunch the SAME argv "
+               "to resume; apps/solve_service.py uses the same code when "
+               "draining), 76 stalled (a wedged peer rank tripped the "
+               "heartbeat watchdog).  A supervisor should retry 75/76 "
+               "and treat other nonzero codes as permanent.")
     ap.add_argument("input", help="YAML config (data/*.yaml schema)")
     ap.add_argument("-o", "--output", default=None,
                     help="output HDF5 (default: <input>.h5); also the "
@@ -123,6 +131,16 @@ def _main(argv=None):
                          "— lets a scheduler multiplexing many concurrent "
                          "solves filter one job's events/spans out of a "
                          "shared stream (obs_report watch/trace read it)")
+    ap.add_argument("--submit", action="store_true",
+                    help="do not solve inline: enqueue this run as a job "
+                         "spec in --serve-dir's spool for a running solve "
+                         "service (apps/solve_service.py) and exit 0; the "
+                         "service batches same-basis submissions through "
+                         "one warm engine and writes the result to "
+                         "<serve-dir>/done/<job_id>.json")
+    ap.add_argument("--serve-dir", default=None, metavar="DIR",
+                    help="solve-service spool directory for --submit "
+                         "(created if missing)")
     ap.add_argument("--health", choices=("on", "strict", "off"),
                     default=None,
                     help="numerical-health watchdog (DMT_HEALTH): on = "
@@ -133,6 +151,33 @@ def _main(argv=None):
     args = ap.parse_args(argv)
     if args.mode is None:
         args.mode = "fused" if args.shards else "ell"
+
+    if args.submit:
+        # enqueue-and-exit: no engine, no solve, no JAX backend touch —
+        # the job spec carries everything the service needs to rebuild
+        # the model (the yaml path) and shape the engine
+        if not args.serve_dir:
+            print("--submit needs --serve-dir DIR (the service's spool)",
+                  file=sys.stderr)
+            return 2
+        if args.shards or args.block:
+            print("--submit covers single-operator Lanczos jobs; "
+                  "--shards/--block runs stay inline", file=sys.stderr)
+            return 2
+        import uuid
+
+        from distributed_matvec_tpu.serve import JobSpec, submit_to_spool
+
+        job_id = args.job_id or f"cli-{uuid.uuid4().hex[:10]}"
+        spec = JobSpec(job_id=job_id, yaml=os.path.abspath(args.input),
+                       k=args.num_evals, tol=args.tol,
+                       max_iters=args.max_iters, mode=args.mode,
+                       n_devices=args.devices)
+        path = submit_to_spool(args.serve_dir, spec)
+        print(f"submitted job {job_id} -> {path}")
+        print(f"result will land at "
+              f"{os.path.join(args.serve_dir, 'done', job_id + '.json')}")
+        return 0
 
     from distributed_matvec_tpu import obs
     from distributed_matvec_tpu.io import (

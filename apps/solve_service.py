@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Solve service: drain (or keep serving) a spool of diagonalize jobs.
+
+The process behind the job-stream layer (DESIGN.md §26,
+``distributed_matvec_tpu/serve/``): scans ``<serve_dir>/queue/`` for job
+specs (written by ``apps/diagonalize.py --submit --serve-dir DIR`` or
+any JSON writer), admits them against the calibrated capacity model,
+groups same-engine jobs, batches each group through ``lanczos_block``'s
+multi-RHS path over a warm LRU engine pool, and writes per-job results
+into ``<serve_dir>/done/<job_id>.json``.
+
+Exit-code contract (shared with diagonalize — a supervisor treats both
+the same way):
+
+* ``0``   — drained (``--drain``) or stopped after ``--max-idle-s``.
+* ``75``  — preempted (SIGTERM/SIGINT latched): the running batch exits
+  at its next block boundary, every in-flight job is respooled as
+  queued, telemetry is flushed.  Relaunch with the same argv to resume
+  the undone work.
+* ``76``  — stalled (a wedged peer tripped the heartbeat watchdog in a
+  multi-process deployment).
+
+Usage::
+
+    python apps/solve_service.py /path/to/spool --drain
+    python apps/solve_service.py /path/to/spool --max-idle-s 300 \\
+        --obs-dir /tmp/serve_run
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from distributed_matvec_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit codes: 0 drained/idle-stopped, 75 preempted "
+               "(relaunch the same argv to resume), 76 stalled")
+    ap.add_argument("serve_dir", help="spool directory (queue/ + done/)")
+    ap.add_argument("--drain", action="store_true",
+                    help="exit 0 once the queue is empty instead of "
+                         "polling for new submissions")
+    ap.add_argument("--poll-s", type=float, default=0.5,
+                    help="spool scan interval while idle (default 0.5)")
+    ap.add_argument("--max-idle-s", type=float, default=None,
+                    help="stop after this much continuous idleness "
+                         "(default: serve forever)")
+    ap.add_argument("--block-width", type=int, default=None,
+                    help="max jobs batched into one lanczos_block call "
+                         "(default: config serve_block_width)")
+    ap.add_argument("--pool-gb", type=float, default=None,
+                    help="engine-pool byte budget in GB (default: config "
+                         "serve_pool_gb)")
+    ap.add_argument("--hbm-gb", type=float, default=16.0,
+                    help="device-memory budget admission prices against")
+    ap.add_argument("--host-ram-gb", type=float, default=64.0,
+                    help="host-RAM budget for streamed-mode plans")
+    ap.add_argument("--calibration", default=None, metavar="PATH",
+                    help="rate-calibration JSON (tools/gather_bound.py); "
+                         "default: the content-addressed sidecar when "
+                         "present — admission ETAs are unpriced without "
+                         "one")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="telemetry run directory (job_event/admission/"
+                         "engine_pool events; `obs_report watch DIR` "
+                         "renders the live queue panel)")
+    args = ap.parse_args(argv)
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.serve import (EnginePool, JobQueue,
+                                              Scheduler, SolveService)
+    from distributed_matvec_tpu.utils.config import update_config
+
+    if args.obs_dir:
+        update_config(obs_dir=args.obs_dir)
+
+    with obs.span("solve_service", kind="run"):
+        pool = EnginePool(max_bytes=int(args.pool_gb * 1e9)
+                          if args.pool_gb is not None else None)
+        sched = Scheduler(queue=JobQueue(args.serve_dir), pool=pool,
+                          calibration_path=args.calibration,
+                          block_width=args.block_width,
+                          hbm_gb=args.hbm_gb,
+                          host_ram_gb=args.host_ram_gb)
+        rc = SolveService(args.serve_dir, scheduler=sched,
+                          poll_s=args.poll_s).run(
+            drain=args.drain, max_idle_s=args.max_idle_s)
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -457,6 +457,124 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     return out
 
 
+def _bench_serve(name, *args, **kwargs):
+    with obs.span(f"bench:{name}", kind="config", config=name):
+        return _bench_serve_impl(name, *args, **kwargs)
+
+
+def _serve_job_specs(n_jobs):
+    """The mixed load: >=2 distinct bases with >=3 jobs sharing one (the
+    ISSUE 11 acceptance shape), heterogeneous (k, tol) per job.  All
+    tolerances <= 1e-8: the Lanczos eigenvalue error is quadratic in the
+    residual bound, so batched and solo runs agree at rtol 1e-12 even
+    though their start columns differ."""
+    from distributed_matvec_tpu.serve import JobSpec
+
+    A = dict(number_spins=12, hamming_weight=6)      # shared by 4 jobs
+    B = dict(number_spins=10, hamming_weight=5)      # shared by 3
+    C = dict(number_spins=8, hamming_weight=4)
+    protos = (("a0", A, 1, 1e-10), ("a1", A, 2, 1e-9),
+              ("a2", A, 1, 1e-8), ("a3", A, 1, 1e-10),
+              ("b0", B, 1, 1e-10), ("b1", B, 1, 1e-9),
+              ("b2", B, 2, 1e-8), ("c0", C, 1, 1e-10))
+    return [JobSpec(job_id=f"{protos[i % len(protos)][0]}_{i}",
+                    basis=dict(protos[i % len(protos)][1]),
+                    k=protos[i % len(protos)][2],
+                    tol=protos[i % len(protos)][3], max_iters=400)
+            for i in range(n_jobs)]
+
+
+def _bench_serve_impl(name, n_jobs=8, warm=True):
+    """Solve-service load generator (DESIGN.md §26): submit ``n_jobs``
+    mixed jobs as one burst, drain them through the scheduler (engine
+    pool + batched ``lanczos_block`` with per-job convergence), and
+    record throughput (``serve_solves_per_min``) and latency percentiles
+    (``serve_p50_latency_ms`` / ``serve_p99_latency_ms``) as
+    first-class, trend-gated BENCH metrics — plus the measured
+    engine-pool sharing (builds < jobs) and the batched-vs-solo
+    comparison: the same job list solved sequentially, one
+    ``lanczos_block`` per job, must be SLOWER than the batched service
+    pass (``serve_batch_speedup`` > 1).  With ``warm`` (default) both
+    passes run once un-measured first so the recorded numbers are the
+    steady serving state (a service amortizes its compiles), not a
+    cold-start artifact."""
+    import jax
+
+    from distributed_matvec_tpu.serve import EnginePool, JobQueue, Scheduler
+    from distributed_matvec_tpu.serve.pool import build_engine
+    from distributed_matvec_tpu.solve import lanczos_block
+
+    obs.emit("bench_config_start", config=name)
+
+    def serve_pass(specs):
+        queue = JobQueue()
+        pool = EnginePool()
+        sched = Scheduler(queue=queue, pool=pool)
+        t0 = time.perf_counter()
+        for s in specs:
+            sched.submit(s)
+        sched.drain(scan_spool=False)
+        wall = time.perf_counter() - t0
+        return wall, queue, pool
+
+    def solo_pass(specs):
+        t0 = time.perf_counter()
+        e0 = {}
+        for s in specs:
+            eng = build_engine(s)
+            r = lanczos_block(eng.matvec, n=eng.n_states, k=s.k,
+                              tol=s.tol, max_iters=s.max_iters,
+                              seed=s.column_seed())
+            e0[s.job_id] = [float(w) for w in r.eigenvalues]
+        return time.perf_counter() - t0, e0
+
+    if warm:
+        _progress(f"{name}: warm-up pass ({n_jobs} jobs)")
+        serve_pass(_serve_job_specs(n_jobs))
+        solo_pass(_serve_job_specs(n_jobs))
+
+    _progress(f"{name}: measured serve pass ({n_jobs} jobs, burst)")
+    specs = _serve_job_specs(n_jobs)
+    wall, queue, pool = serve_pass(specs)
+    _progress(f"{name}: measured solo pass (sequential, same job list)")
+    solo_wall, solo_e0 = solo_pass(_serve_job_specs(n_jobs))
+
+    lat, e0_err = [], 0.0
+    n_done = 0
+    for s in specs:
+        rec = queue.result(s.job_id)
+        if not rec or rec["status"] != "done":
+            continue
+        n_done += 1
+        lat.append(float(rec["latency_ms"]))
+        for w, ws in zip(rec["eigenvalues"], solo_e0[s.job_id]):
+            e0_err = max(e0_err, abs(w - ws) / max(abs(ws), 1e-300))
+    out = {
+        "config": name,
+        "serve_jobs": int(n_jobs),
+        "serve_jobs_done": int(n_done),
+        "serve_wall_s": round(wall, 3),
+        "serve_solves_per_min": round(60.0 * n_done / max(wall, 1e-9), 2),
+        "serve_p50_latency_ms": round(float(np.percentile(lat, 50)), 3)
+        if lat else None,
+        "serve_p99_latency_ms": round(float(np.percentile(lat, 99)), 3)
+        if lat else None,
+        "serve_engine_builds": int(pool.builds),
+        "serve_engine_hits": int(pool.hits),
+        "serve_pool_bytes": int(pool.total_bytes()),
+        "solo_wall_s": round(solo_wall, 3),
+        "serve_batch_speedup": round(solo_wall / max(wall, 1e-9), 2),
+        "serve_e0_max_rel_err": float(e0_err),
+        "backend": str(jax.default_backend()),
+    }
+    _progress(f"{name}: {out['serve_solves_per_min']} solves/min, "
+              f"p99 {out['serve_p99_latency_ms']} ms, "
+              f"{pool.builds} engine builds for {n_jobs} jobs, "
+              f"batched {out['serve_batch_speedup']}x vs solo")
+    obs.emit("bench_result", **out)
+    return out
+
+
 CHAIN_32_SYMM = dict(number_spins=32, hamming_weight=16, spin_inversion=1,
                      symmetries=[([*range(1, 32), 0], 0),
                                  ([*reversed(range(32))], 0)])
@@ -516,6 +634,21 @@ def _main():
                     help="run the full CPU-feasible config matrix on the "
                          "CPU backend (what a failed device probe degrades "
                          "to automatically)")
+    ap.add_argument("--serve", action="store_true",
+                    help="solve-service load generator instead of the "
+                         "matvec matrix: burst-submit a mixed job list "
+                         "through serve/ (engine pool + batched "
+                         "lanczos_block), recording serve_solves_per_min "
+                         "and p50/p99 latency as trend-gated metrics plus "
+                         "the batched-vs-solo speedup (DESIGN.md §26); "
+                         "runs on the current backend (pin JAX_PLATFORMS="
+                         "cpu on the CPU rig)")
+    ap.add_argument("--serve-jobs", type=int, default=8, metavar="N",
+                    help="job count for --serve (default 8: 3 bases, one "
+                         "shared by 4 jobs)")
+    ap.add_argument("--serve-cold", action="store_true",
+                    help="skip the --serve warm-up pass (records "
+                         "cold-start numbers, compiles included)")
     ap.add_argument("--detail-out", default=None, metavar="PATH",
                     help="where to write the per-config detail JSON "
                          "(default: BENCH_DETAIL.json next to this script; "
@@ -540,8 +673,8 @@ def _main():
 
     # Full runs target the accelerator, which can be wedged — probe first and
     # degrade to a marked CPU fallback run rather than hanging the driver.
-    if (not args.smoke and not args.cpu_fallback and not args.no_probe
-            and not _probe_device()):
+    if (not args.smoke and not args.cpu_fallback and not args.serve
+            and not args.no_probe and not _probe_device()):
         _progress("falling back to a CPU run of the full small-config matrix")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         # re-exec keeps the output-path/profiling flags: the fallback run
@@ -573,7 +706,11 @@ def _main():
     obs.emit("bench_start", argv=sys.argv[1:], obs_dir=obs.run_dir() or "")
 
     detail = {}
-    if args.smoke:
+    if args.serve:
+        main_cfg = _bench_serve("serve_mixed", n_jobs=args.serve_jobs,
+                                warm=not args.serve_cold)
+        detail["serve_mixed"] = main_cfg
+    elif args.smoke:
         # 50 timing repeats (each ~1 ms on CPU): a 5-repeat mean scattered
         # ~5× run-to-run on a shared host, far too noisy for the obs-check
         # perf gate to compare against
@@ -672,12 +809,21 @@ def _main():
     # carrying the full per-config detail gets tail-truncated and parses as
     # null (BENCH_r04.json).  Keep the printed line short and write the
     # detail dict to a sidecar file the judge can read from the repo.
-    line = {
-        "metric": "Hx_wallclock_ms_" + main_cfg.get("config", "unknown"),
-        "value": main_cfg.get("device_ms", 0),
-        "unit": "ms",
-        "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
-    }
+    if args.serve:
+        line = {
+            "metric": "serve_solves_per_min",
+            "value": main_cfg.get("serve_solves_per_min", 0),
+            "unit": "solves/min",
+            "vs_baseline": main_cfg.get("serve_batch_speedup", 0),
+        }
+    else:
+        line = {
+            "metric": "Hx_wallclock_ms_" + main_cfg.get("config",
+                                                        "unknown"),
+            "value": main_cfg.get("device_ms", 0),
+            "unit": "ms",
+            "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
+        }
     detail_path = args.detail_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     try:
@@ -708,7 +854,8 @@ def _main():
                 os.path.dirname(os.path.abspath(__file__)), "tools"))
             import bench_trend
 
-            mode = ("smoke" if args.smoke
+            mode = ("serve" if args.serve
+                    else "smoke" if args.smoke
                     else "cpu_fallback" if args.cpu_fallback else "full")
             rec = bench_trend.compact_record(
                 {"main": main_cfg, **detail}, mode=mode,

@@ -1,0 +1,36 @@
+"""Metric direction registry — the ONE place that says which way is up.
+
+Every gate in the repo (``obs_report diff``, ``bench_trend gate``, the
+check scripts that wrap them) needs the same answer to the same
+question: for metric X, is a LOWER new value the regression (rates,
+speedups, throughputs) or a HIGHER one (walls, bytes, error bounds)?
+Until the solve service each tool carried its own copy of that list;
+this module is the shared table both import, so registering a new
+metric's direction (e.g. ``serve_solves_per_min``: higher is better)
+happens exactly once.
+
+The rule is tag-based, not an exact-name whitelist: any metric whose
+name contains one of :data:`HIGHER_IS_BETTER_TAGS` is higher-is-better,
+everything else numeric is cost-like (growth is the regression) — which
+is the DELIBERATE registration for error metrics like
+``compress_rel_err``/``compress_drift_max``: numerical error growing is
+the regression, so they gate correctly under the default rule.
+"""
+
+from __future__ import annotations
+
+__all__ = ["HIGHER_IS_BETTER_TAGS", "is_higher_better"]
+
+#: Substring tags marking rate-like metrics (higher is better).
+#: ``solves_per_min`` covers the solve service's throughput
+#: (``serve_solves_per_min``); latency percentiles
+#: (``serve_p99_latency_ms``) fall through to the cost-like default.
+HIGHER_IS_BETTER_TAGS = (
+    "iters_per_s", "speedup", "_rate", "hit_rate",
+    "compress_ratio", "overlap_fraction", "solves_per_min",
+)
+
+
+def is_higher_better(metric: str) -> bool:
+    """True when a LOWER value of ``metric`` is the regression."""
+    return any(tag in metric for tag in HIGHER_IS_BETTER_TAGS)

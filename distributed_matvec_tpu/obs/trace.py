@@ -226,6 +226,67 @@ def span(name: str, kind: str = "span", **attrs):
     return _span_cm(name, kind, attrs)
 
 
+def emit_span(name: str, kind: str, t0: float, dur_ms: float,
+              **attrs) -> None:
+    """One retro-dated span event parented to the CURRENTLY open span —
+    for work whose extent is known only after the fact and cannot ride
+    the context-manager nesting (the solve service's per-job spans: a
+    job's in-batch window closes when its column converges, while the
+    batch span is still open).  The span is pushed for exactly the
+    duration of its own event emission — same emit-before-pop move as
+    the context manager, so the envelope stamper records the span's own
+    id on its event — and carries the caller's ``t0``/``dur_ms`` rather
+    than wall-clock-now."""
+    if not trace_enabled():
+        return
+    with _lock:
+        parent = _stack[-1].sid if _stack else None
+        sp = _Span(str(name), str(kind), _next_span_id(), parent, attrs)
+        _stack.append(sp)
+    try:
+        emit("span", name=sp.name, cat=sp.kind, parent_span_id=parent,
+             t0=round(float(t0), 6), dur_ms=round(float(dur_ms), 4),
+             **attrs)
+    finally:
+        with _lock:
+            try:
+                _stack.remove(sp)
+            except ValueError:
+                pass
+
+
+@contextmanager
+def _job_scope_cm(jid: str):
+    from ..utils.config import get_config, update_config
+    old_env = os.environ.get("DMT_JOB_ID")
+    old_cfg = get_config().job_id
+    # env AND config, the both-or-neither contract of --job-id: the env
+    # var outranks the config field, so scoping only the config would be
+    # silently defeated by an inherited DMT_JOB_ID
+    os.environ["DMT_JOB_ID"] = jid
+    update_config(job_id=jid)
+    try:
+        yield
+    finally:
+        if old_env is None:
+            os.environ.pop("DMT_JOB_ID", None)
+        else:
+            os.environ["DMT_JOB_ID"] = old_env
+        update_config(job_id=old_cfg)
+
+
+def job_scope(jid: Optional[str]):
+    """Context manager stamping ``jid`` as the envelope ``job_id`` of
+    every event emitted inside — how the solve service namespaces one
+    job's lifecycle events and spans inside a multiplexed stream (the
+    envelope drops payload fields that collide with its keys, so a
+    payload ``job_id=`` could never do this).  No-op when tracing is off
+    or ``jid`` is empty."""
+    if not trace_enabled() or not jid:
+        return nullcontext()
+    return _job_scope_cm(str(jid))
+
+
 def current_span_id() -> Optional[str]:
     """The innermost open span's id, or None."""
     with _lock:

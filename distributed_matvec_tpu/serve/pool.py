@@ -1,0 +1,144 @@
+"""Engine pool — warm engines shared across jobs, LRU under a byte budget.
+
+Engine acquisition is the expensive part of a small solve (structure
+build / plan resolution; the content-addressed artifact + AOT caches of
+PR 1 make a REBUILD cheap, but a resident engine is free).  The pool
+holds built engines keyed by :meth:`JobSpec.engine_key` so every job of
+a same-basis group shares ONE engine — device-resident tables, host-RAM
+compressed plans, and cached AOT executables included — and evicts
+least-recently-used engines when the resident bytes exceed the budget
+(``serve_pool_gb``, the ``artifact_max_gb``-style knob of this layer).
+
+Eviction drops the pool's reference; the device-memory ledger's weakref
+finalizers (PR 4) release the tracked allocations when the engine is
+collected, so pool occupancy and the ledger stay consistent.  Every
+acquire/build/evict emits an ``engine_pool`` event — the watch panel's
+occupancy line.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..obs import emit as obs_emit
+from ..utils.config import get_config
+from .spec import JobSpec
+
+__all__ = ["EnginePool", "build_operator", "build_engine", "engine_bytes"]
+
+
+def build_operator(spec: JobSpec):
+    """The spec's Hamiltonian: inline Heisenberg (basis + edges, chain
+    when ``edges`` is None) or the yaml config's hamiltonian."""
+    if spec.yaml is not None:
+        from ..models.yaml_io import load_config_from_yaml
+        cfg = load_config_from_yaml(spec.yaml, hamiltonian=True)
+        if cfg.hamiltonian is None:
+            raise ValueError(f"{spec.yaml}: config has no hamiltonian")
+        return cfg.hamiltonian
+    from ..models.basis import SpinBasis
+    from ..models.lattices import chain_edges, heisenberg_from_edges
+    basis = SpinBasis(**spec.basis)
+    edges = (list(map(tuple, spec.edges)) if spec.edges is not None
+             else chain_edges(int(spec.basis["number_spins"])))
+    return heisenberg_from_edges(basis, edges)
+
+
+def build_engine(spec: JobSpec, mesh=None):
+    """One engine for the spec: LocalEngine for single-device non-streamed
+    jobs, DistributedEngine otherwise (``mesh`` — e.g. a rank-local mesh
+    on the 2-proc CPU rig — wins over ``n_devices``)."""
+    op = build_operator(spec)
+    if mesh is None and spec.n_devices in (0, 1) \
+            and spec.mode != "streamed":
+        from ..parallel.engine import LocalEngine
+        return LocalEngine(op, mode=spec.mode)
+    from ..parallel.distributed import DistributedEngine
+    return DistributedEngine(op, mesh=mesh,
+                             n_devices=None if mesh is not None
+                             else (spec.n_devices or 1),
+                             mode=spec.mode)
+
+
+def engine_bytes(eng) -> int:
+    """Resident footprint the budget counts: device structure tables
+    plus the streamed mode's host-RAM plan (encoded bytes)."""
+    total = 0
+    for attr in ("ell_nbytes", "plan_bytes"):
+        try:
+            total += int(getattr(eng, attr, 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    return total
+
+
+class EnginePool:
+    """LRU of warm engines keyed by engine fingerprint."""
+
+    def __init__(self, max_bytes: Optional[int] = None, mesh=None,
+                 builder: Optional[Callable] = None):
+        if max_bytes is None:
+            max_bytes = int(get_config().serve_pool_gb * 1e9)
+        self.max_bytes = int(max_bytes)
+        self.mesh = mesh
+        self._builder = builder or (lambda spec: build_engine(spec,
+                                                              mesh=self.mesh))
+        self._engines: "OrderedDict[str, object]" = OrderedDict()
+        self._bytes: dict = {}
+        self.builds = 0
+        self.hits = 0
+        self.evictions = 0
+
+    # -- introspection -----------------------------------------------------
+
+    def total_bytes(self) -> int:
+        return sum(self._bytes.values())
+
+    def __len__(self) -> int:
+        return len(self._engines)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._engines
+
+    def keys(self):
+        return list(self._engines)
+
+    # -- acquire / evict ---------------------------------------------------
+
+    def acquire(self, spec: JobSpec):
+        """The warm engine for ``spec`` (LRU-refreshed), building on miss
+        and evicting LRU engines past the byte budget.  The just-built
+        engine is never evicted by its own insertion — a single engine
+        larger than the budget still serves its batch (and is evicted by
+        the NEXT insertion)."""
+        key = spec.engine_key()
+        eng = self._engines.get(key)
+        if eng is not None:
+            self._engines.move_to_end(key)
+            self.hits += 1
+            self._event("hit", key)
+            return eng
+        eng = self._builder(spec)
+        self.builds += 1
+        self._engines[key] = eng
+        self._bytes[key] = engine_bytes(eng)
+        self._evict(keep=key)
+        self._event("build", key)
+        return eng
+
+    def _evict(self, keep: str) -> None:
+        while self.total_bytes() > self.max_bytes and len(self._engines) > 1:
+            victim = next(k for k in self._engines if k != keep)
+            self._engines.pop(victim)
+            freed = self._bytes.pop(victim, 0)
+            self.evictions += 1
+            self._event("evict", victim, freed_bytes=int(freed))
+
+    def _event(self, event: str, key: str, **extra) -> None:
+        obs_emit("engine_pool", event=event, engine_key=key,
+                 engine_bytes=int(self._bytes.get(key, 0)),
+                 pool_bytes=int(self.total_bytes()),
+                 pool_max_bytes=int(self.max_bytes),
+                 engines=len(self._engines), builds=self.builds,
+                 hits=self.hits, evictions=self.evictions, **extra)

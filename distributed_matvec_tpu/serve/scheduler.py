@@ -1,0 +1,294 @@
+"""Scheduler — admission, fingerprint grouping, batch packing, execution.
+
+The unit of work here is a JOB STREAM, not a single solve:
+
+* **Admission** is priced, not guessed: every submission is run through
+  ``tools/capacity.price_job`` (the calibrated roofline rates of PR 7)
+  and gets a verdict — ``accept`` (fits, runs within the accept
+  horizon), ``queue`` (fits, but the priced backlog puts its start
+  beyond the horizon — the verdict carries the ETA), or ``reject``
+  (does not fit the device/host budgets at all, or cannot meet its
+  deadline).  The device is never oversubscribed on a hunch.
+* **Grouping**: queued jobs are grouped by :meth:`JobSpec.engine_key`;
+  a batch takes up to ``serve_block_width`` jobs of ONE group (FIFO by
+  the group's oldest submission, then job_id — deterministic packing),
+  so same-basis requests share one warm engine from the
+  :class:`~.pool.EnginePool`.
+* **Execution**: the batch runs as ONE ``lanczos_block`` call with
+  per-job ``column_targets`` — each job contributes a start column
+  seeded by its own job_id, converges against its own (k, tol), and its
+  column EXITS the batch when done (the block narrows; see
+  ``solve/lanczos.py``).  Per-job results, latencies, and ``job`` spans
+  land under the run's trace tree.
+* **Preemption**: a SIGTERM latched by the PR 6 machinery surfaces as
+  ``Preempted`` at a block boundary; the batch's unfinished jobs are
+  requeued (their spool files never left ``queue/``) and the exception
+  propagates so the service can exit 75 — the drain contract
+  ``make serve-check`` gates.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import emit as obs_emit
+from ..obs import trace as obs_trace
+from ..utils import preempt
+from ..utils.config import get_config
+from .pool import EnginePool
+from .queue import DONE, FAILED, REJECTED, JobQueue
+from .spec import JobSpec
+
+__all__ = ["Scheduler", "load_capacity_module"]
+
+_capacity = None
+
+
+def load_capacity_module():
+    """``tools/capacity.py`` as a module (tools/ is not a package; the
+    pricing API lives there so the CLI and the scheduler share one
+    model).  Cached — the import cost is paid once."""
+    global _capacity
+    if _capacity is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "tools", "capacity.py")
+        spec = importlib.util.spec_from_file_location("dmt_capacity", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _capacity = mod
+    return _capacity
+
+
+class Scheduler:
+    """Admission + packing + execution over one queue and one pool."""
+
+    def __init__(self, queue: Optional[JobQueue] = None,
+                 pool: Optional[EnginePool] = None,
+                 rates: Optional[dict] = None,
+                 calibration_path: Optional[str] = None,
+                 block_width: Optional[int] = None,
+                 hbm_gb: float = 16.0, host_ram_gb: float = 64.0,
+                 accept_horizon_s: Optional[float] = None,
+                 mesh=None):
+        cfg = get_config()
+        self.queue = queue if queue is not None else JobQueue()
+        self.pool = pool if pool is not None else EnginePool(mesh=mesh)
+        self.block_width = int(block_width or cfg.serve_block_width)
+        self.hbm_gb = float(hbm_gb)
+        self.host_ram_gb = float(host_ram_gb)
+        self.accept_horizon_s = float(
+            accept_horizon_s if accept_horizon_s is not None
+            else cfg.serve_accept_horizon_s)
+        if rates is None:
+            try:
+                rates = load_capacity_module().load_rate_calibration(
+                    calibration_path)
+            except Exception:
+                if calibration_path:    # an explicit path must not be
+                    raise               # silently dropped
+                rates = None
+        self.rates = rates
+        self._backlog_s = 0.0          # priced est_solve_s of queued work
+        self._est_s: Dict[str, float] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, spec: JobSpec) -> dict:
+        """Price one spec and return the admission verdict (also emitted
+        as an ``admission`` event).  Does NOT enqueue — :meth:`submit`
+        composes the two."""
+        cap = load_capacity_module()
+        price = cap.price_job(spec.pricing(), calibration=self.rates,
+                              hbm_gb=self.hbm_gb,
+                              host_ram_gb=self.host_ram_gb)
+        eta_s = round(self._backlog_s, 3)
+        if not price["fits"]:
+            verdict = "reject"
+            reason = price.get("reason") or "does not fit the device budget"
+        elif (spec.deadline_s is not None
+              and price.get("est_solve_s") is not None
+              and eta_s + price["est_solve_s"] > float(spec.deadline_s)):
+            verdict = "reject"
+            reason = (f"priced finish {eta_s + price['est_solve_s']:.1f}s "
+                      f"exceeds deadline {spec.deadline_s:.1f}s")
+        elif eta_s > self.accept_horizon_s:
+            verdict, reason = "queue", f"priced backlog {eta_s:.1f}s"
+        else:
+            verdict, reason = "accept", ""
+        out = {"verdict": verdict, "eta_s": eta_s, "reason": reason,
+               **{k: price.get(k) for k in
+                  ("est_apply_ms", "est_solve_s", "fits")}}
+        with obs_trace.job_scope(spec.job_id):
+            obs_emit("admission", job_id=spec.job_id,
+                     engine_key=spec.engine_key(), **{
+                         k: v for k, v in out.items() if v is not None})
+        return out
+
+    def _admit_and_track(self, spec: JobSpec, enqueue: bool) -> dict:
+        """The one admit -> reject-or-track path both submission routes
+        share: price the spec, record a rejection terminally, otherwise
+        fold its priced solve time into the backlog (and enqueue it when
+        it is not already in the queue).  The spec instance is marked
+        admitted, so a re-adopted (resubmitted) spec — a FRESH instance
+        from the spool — is re-priced while an already-admitted queued
+        one is not."""
+        verdict = self.admit(spec)
+        spec.__dict__["_admitted"] = True
+        if verdict["verdict"] == "reject":
+            self.queue.finish(spec, REJECTED, reason=verdict["reason"],
+                              eta_s=verdict["eta_s"])
+            return verdict
+        if enqueue:
+            self.queue.submit(spec)
+        if verdict.get("est_solve_s") is not None:
+            self._est_s[spec.job_id] = float(verdict["est_solve_s"])
+            self._backlog_s += self._est_s[spec.job_id]
+        return verdict
+
+    def submit(self, spec: JobSpec) -> dict:
+        """Admit + enqueue (or record the rejection).  Returns the
+        verdict dict."""
+        return self._admit_and_track(spec, enqueue=True)
+
+    def adopt_spool(self) -> int:
+        """Scan the spool for new ``--submit`` arrivals and run admission
+        on each (a spooled job that does not fit is rejected with a
+        terminal record, exactly like an API submission)."""
+        adopted = self.queue.scan_spool()
+        if adopted:
+            for spec in list(self.queue.queued()):
+                if not spec.__dict__.get("_admitted"):
+                    self._admit_and_track(spec, enqueue=False)
+        return adopted
+
+    # -- packing -----------------------------------------------------------
+
+    def next_batch(self) -> List[JobSpec]:
+        """Up to ``block_width`` queued jobs of ONE engine-key group:
+        the group whose head job queued earliest goes first (FIFO
+        fairness across groups), members ordered by (submit_ts, job_id)
+        — deterministic, so a rerun of the same queue packs the same
+        batches (the §26 bit-identity argument)."""
+        groups: Dict[str, List[JobSpec]] = {}
+        for s in self.queue.queued():
+            groups.setdefault(s.engine_key(), []).append(s)
+        if not groups:
+            return []
+        head = min(groups.values(),
+                   key=lambda g: min((s.submit_ts, s.job_id) for s in g))
+        head.sort(key=lambda s: (s.submit_ts, s.job_id))
+        return head[: self.block_width]
+
+    # -- execution ---------------------------------------------------------
+
+    def run_batch(self, batch: List[JobSpec]) -> List[dict]:
+        """One batched solve: acquire the group's engine, start columns
+        seeded per job, per-job convergence targets, results recorded per
+        job.  ``Preempted`` requeues the whole batch and propagates."""
+        from ..solve import lanczos_block
+
+        key = batch[0].engine_key()
+        t_start = time.time()
+        for spec in batch:
+            self.queue.mark_running(spec, batch_width=len(batch))
+        try:
+            with obs_trace.span("serve_batch", kind="batch",
+                                engine_key=key, jobs=len(batch)):
+                eng = self.pool.acquire(batch[0])
+                p = max(len(batch), max(int(s.k) for s in batch), 2)
+                V0 = self._start_block(eng, batch, p)
+                targets = [{"k": int(s.k), "tol": float(s.tol),
+                            "max_iters": int(s.max_iters),
+                            "job_id": s.job_id} for s in batch]
+                res = lanczos_block(
+                    eng.matvec,
+                    n=None if V0 is not None else eng.n_states,
+                    k=max(int(s.k) for s in batch),
+                    block_size=p, V0=V0,
+                    max_iters=max(int(s.max_iters) for s in batch),
+                    tol=min(float(s.tol) for s in batch),
+                    column_targets=targets)
+                out = []
+                now = time.time()
+                for spec, cr in zip(batch, res.column_results or []):
+                    rec = self._finish(
+                        spec, DONE if cr["converged"] else FAILED,
+                        t_start,
+                        eigenvalues=[float(w) for w in
+                                     np.atleast_1d(cr["eigenvalues"])],
+                        residuals=[float(r) for r in
+                                   np.atleast_1d(cr["residuals"])],
+                        iters=int(cr["iters"]),
+                        converged=bool(cr["converged"]),
+                        batch_width=len(batch))
+                    # per-job span: the job's in-batch execution window
+                    # (batch start -> batch close), a CHILD of the still-
+                    # open serve_batch span, envelope-stamped with the
+                    # job's own id via job_scope
+                    with obs_trace.job_scope(spec.job_id):
+                        obs_trace.emit_span(
+                            f"job:{spec.job_id}", "job", t0=t_start,
+                            dur_ms=(now - t_start) * 1e3,
+                            engine_key=key, iters=int(cr["iters"]))
+                    out.append(rec)
+                return out
+        except preempt.Preempted:
+            for spec in batch:
+                self.queue.requeue(spec, reason="preempted")
+            raise
+        except Exception as e:              # noqa: BLE001 — one broken
+            for spec in batch:              # batch must not kill the service
+                self._finish(spec, FAILED, t_start, error=repr(e))
+            obs_emit("serve_batch_failed", engine_key=key, error=repr(e))
+            return [self.queue.result(s.job_id) for s in batch]
+
+    def _finish(self, spec: JobSpec, status: str, t_start: float,
+                **result) -> dict:
+        self._backlog_s = max(
+            0.0, self._backlog_s - self._est_s.pop(spec.job_id, 0.0))
+        latency_ms = (time.time() - float(spec.submit_ts or t_start)) * 1e3
+        return self.queue.finish(spec, status,
+                                 latency_ms=round(latency_ms, 3), **result)
+
+    def _start_block(self, eng, batch: List[JobSpec], p: int):
+        """The batch's start block: column j is seeded by job j's
+        :meth:`~.spec.JobSpec.column_seed` (extra columns past the job
+        count — a job wanting k > len(batch) eigenpairs — are seeded off
+        the first job's seed), so the block depends only on batch
+        membership, never on wall-clock or scheduler timing."""
+        seeds = [s.column_seed() for s in batch]
+        seeds += [seeds[0] + 1 + i for i in range(p - len(seeds))]
+        if hasattr(eng, "random_hashed"):       # hashed [D, M, p] layout
+            import jax.numpy as jnp
+            cols = [eng.random_hashed(seed=sd, cols=1) for sd in seeds]
+            return jnp.concatenate(cols, axis=-1)
+        n = int(eng.n_states)
+        cols = [np.random.default_rng(sd).standard_normal(n)
+                for sd in seeds]
+        V0 = np.stack(cols, axis=1)
+        return V0 / np.linalg.norm(V0, axis=0, keepdims=True)
+
+    # -- drain loop --------------------------------------------------------
+
+    def drain(self, scan_spool: bool = True) -> int:
+        """Run batches until the queue is empty (adopting spool arrivals
+        between batches).  Returns the number of jobs driven to a
+        terminal state.  ``Preempted`` propagates after requeueing — the
+        caller owns the exit code."""
+        finished = 0
+        while True:
+            if scan_spool:
+                self.adopt_spool()
+            if preempt.requested():
+                raise preempt.Preempted("serve_drain", finished, None)
+            batch = self.next_batch()
+            if not batch:
+                return finished
+            self.run_batch(batch)
+            finished += len(batch)

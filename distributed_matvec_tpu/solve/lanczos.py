@@ -215,6 +215,10 @@ class LanczosResult:
     first_block_seconds: float = 0.0
     first_block_iters: int = 0
     steady_seconds: float = 0.0
+    #: per-target results of a ``column_targets`` batch solve (the solve
+    #: service's heterogeneous-convergence path), aligned with the
+    #: targets list; None for ordinary solves
+    column_results: Optional[list] = None
 
     @property
     def steady_iters_per_s(self) -> float:
@@ -587,6 +591,7 @@ def _lanczos_block_impl(
     seed: int = 0,
     V0=None,
     compute_eigenvectors: bool = False,
+    column_targets=None,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs via *block* Lanczos over the batched matvec.
 
@@ -610,6 +615,23 @@ def _lanczos_block_impl(
     ``max_iters`` counts *individual matvec columns* (p per block step),
     so budgets are comparable with :func:`lanczos`.
 
+    Heterogeneous convergence (``column_targets``, the solve service's
+    batched path — DESIGN.md §26): a list of ``{"k", "tol", "job_id"}``
+    mappings, one per batched job.  Every target is judged each step
+    against ITS OWN (k, tol) on the shared Ritz pairs; when a target
+    converges its result is snapshotted (eigenvalues/residuals at that
+    basis size) and its column EXITS the batch: the basis is compressed
+    to the lowest Ritz vectors and the recurrence RESTARTS at the
+    narrower width (restarted block Lanczos — naive column truncation
+    would discard live Krylov directions and silently break the
+    residual bound, so narrowing always goes through a restart; every
+    reported residual is an exact recurrence residual).  The solve ends
+    when every target is done (``converged`` = all converged);
+    per-target records land in :attr:`LanczosResult.column_results`.
+    Narrowing recompiles the engine apply per new width — worth it
+    whenever the remaining work is more than a few steps (the AOT cache
+    makes repeat widths free).
+
     Hashed multi-RHS: a :class:`~..parallel.distributed.DistributedEngine`
     behind ``matvec`` is driven natively in its hashed ``[D, M, p]``
     layout — pass ``V0`` of that shape, or pass neither ``V0`` nor ``n``
@@ -628,9 +650,22 @@ def _lanczos_block_impl(
             + ("; a PAIR-mode STREAMED engine currently has no in-tree "
                "solver — use mode='ell'/'fused' for pair sectors, or run "
                "the sector native-c128 on CPU" if streamed else ""))
-    p = int(block_size or max(k, 2))
+    targets = None
+    if column_targets is not None:
+        targets = [{"k": int(t.get("k", 1)), "tol": float(t.get("tol", tol)),
+                    "max_iters": int(t["max_iters"])
+                    if t.get("max_iters") else None,
+                    "job_id": t.get("job_id")} for t in column_targets]
+        if not targets:
+            raise ValueError("column_targets must be a non-empty sequence")
+        k = max(int(k), max(t["k"] for t in targets))
+    p = int(block_size or max(k, 2,
+                              len(targets) if targets is not None else 0))
     if p < 1:
         raise ValueError(f"block_size must be >= 1, got {p}")
+    if targets is not None and len(targets) > p:
+        raise ValueError(f"{len(targets)} column targets need a block of "
+                         f"at least that many columns, got {p}")
 
     hashed_owner = (owner is not None and hasattr(owner, "shard_size")
                     and hasattr(owner, "random_hashed"))
@@ -656,10 +691,12 @@ def _lanczos_block_impl(
         # hashed engines consume/produce [D, M, p]; the dense algebra
         # (QR, projections) runs on the flat [D·M, p] view — pad slots are
         # zero by engine invariant, so inner products and factorizations
-        # are exact
-        Y = matvec(X.reshape(vec_shape + (p,))) if vec_shape else matvec(X)
+        # are exact.  Width read off X, not closed over: a column-target
+        # solve narrows the block as jobs finish.
+        pc = int(X.shape[1])
+        Y = matvec(X.reshape(vec_shape + (pc,))) if vec_shape else matvec(X)
         Y = Y[0] if isinstance(Y, tuple) else Y
-        return Y.reshape(-1, p) if vec_shape else Y
+        return Y.reshape(-1, pc) if vec_shape else Y
 
     # Probe eagerly with the QR'd first block and REUSE the result as
     # step 0's apply: fixes the dtype (a complex-Hermitian operator
@@ -673,15 +710,41 @@ def _lanczos_block_impl(
     dtype = jnp.promote_types(V0.dtype, W0.dtype)
     Q = Q.astype(dtype)
     probe_s = _time.perf_counter() - t0
-    blocks = [Q]                     # each [n, p], mutually orthonormal
-    A_list: list = []                # diagonal blocks   [p, p]
-    B_list: list = []                # subdiagonal blocks [p, p]
+    blocks = [Q]                     # each [n, w_i], mutually orthonormal
+    A_list: list = []                # diagonal blocks   [w_i, w_i]
+    B_list: list = []                # subdiagonal blocks [w_{i+1}, w_i]
+    widths: list = []                # per-step block widths (uniform at
+    #                                  p_cur within an epoch — a narrowing
+    #                                  restart resets these lists at the
+    #                                  new width)
     theta = S = res = None
     converged = False
     total = 0
-    max_blocks = max(max_iters // p, 1)
+    p_cur = p
     a_seq: list = []        # scalarized per-step (α, β) for the ω estimate
     b_seq: list = []
+
+    def _ritz_block(S_cols, m_rows):
+        """[n, c] Ritz combinations over the kept blocks covering the
+        first ``m_rows`` basis rows (snapshots are taken at step ends, so
+        block boundaries always align).  Reads ``blocks``/``widths`` at
+        CALL time — valid for any snapshot taken since the last
+        narrowing restart."""
+        offs = np.concatenate(([0], np.cumsum(widths))).astype(int)
+        nb = int(np.searchsorted(offs, m_rows))
+        Sj = jnp.asarray(S_cols, dtype=dtype)
+        return sum(blocks[i] @ Sj[offs[i]: offs[i + 1]]
+                   for i in range(nb))
+
+    def _assemble(S_cols, m_rows):
+        """Normalized Ritz vectors in the matvec's layout."""
+        E = _ritz_block(np.asarray(S_cols), m_rows)
+        out = []
+        for i in range(np.asarray(S_cols).shape[1]):
+            e = E[:, i]
+            e = e / jnp.sqrt(jnp.real(jnp.vdot(e, e))).astype(dtype)
+            out.append(e.reshape(vec_shape) if vec_shape else e)
+        return out
 
     first_block_s = 0.0
     first_block_iters = 0
@@ -691,7 +754,8 @@ def _lanczos_block_impl(
     agree_multi = jax.process_count() > 1 and (
         owner is None or bool(getattr(owner, "_multi", True)))
     obs_emit("solver_start", solver="lanczos_block", k=int(k),
-             block_size=int(p), max_iters=int(max_iters), tol=float(tol))
+             block_size=int(p), max_iters=int(max_iters), tol=float(tol),
+             **({"column_targets": len(targets)} if targets else {}))
 
     # unbounded-basis solver: the block list GROWS — the ledger entry is
     # updated per appended block so forensics show the live footprint
@@ -703,7 +767,8 @@ def _lanczos_block_impl(
         mem_h = obs_memory.track(blk_path, int(Q.nbytes),
                                  block_size=int(p))
 
-    for j in range(max_blocks):
+    j = 0
+    while True:
         faults.check("solver_block", exc=RuntimeError,
                      solver="lanczos_block", iter=int(total))
         # safe point between block steps (no checkpoint machinery here —
@@ -717,7 +782,7 @@ def _lanczos_block_impl(
             mem_h.release()
             raise preempt.Preempted("lanczos_block", total, None)
         t0 = _time.perf_counter()
-        # iteration span: one block step (p matvec columns + the block
+        # iteration span: one block step (p_cur matvec columns + the block
         # recurrence) — the eager engine apply inside nests as its child
         with obs_trace.span("iteration", kind="iteration",
                             solver="lanczos_block", iter=int(total),
@@ -728,7 +793,7 @@ def _lanczos_block_impl(
             W0 = None
             A = Qj.conj().T @ W
             W = W - Qj @ A
-            if j > 0:
+            if B_list:          # empty right after a narrowing restart
                 W = W - blocks[-2] @ B_list[-1].conj().T
             # full reorthogonalization, two passes (classic block-Lanczos
             # loss of orthogonality is what makes the naive recurrence
@@ -745,8 +810,9 @@ def _lanczos_block_impl(
             steady_s += dt
         A_list.append(np.asarray(A))
         B_list.append(np.asarray(B))
-        total += p
-        m = len(A_list) * p
+        widths.append(p_cur)
+        total += p_cur
+        m = sum(widths)
         # scalarized (α, β) proxy for the ω-recurrence: the block analog of
         # β_j is the smallest new-direction magnitude min|diag(R_j)| — the
         # quantity whose collapse signals orthogonality/rank loss — and of
@@ -755,28 +821,75 @@ def _lanczos_block_impl(
         b_seq.append(float(np.min(np.abs(np.diag(B_list[-1])))))
 
         # projected block-tridiagonal matrix (Hermitian by construction;
-        # A is numerically Hermitian only to roundoff — symmetrize)
+        # A is numerically Hermitian only to roundoff — symmetrize).
+        # Offsets come from the widths list; within one epoch (between
+        # narrowing restarts, which reset these lists) every block is
+        # p_cur wide, so all blocks here are square at widths[i]
         T = np.zeros((m, m), dtype=np.result_type(*A_list))
+        off = 0
         for i, Ai in enumerate(A_list):
-            sl = slice(i * p, (i + 1) * p)
-            T[sl, sl] = (Ai + Ai.conj().T) / 2
+            w = widths[i]
+            T[off: off + w, off: off + w] = (Ai + Ai.conj().T) / 2
+            off += w
+        off = 0
         for i, Bi in enumerate(B_list[:-1]):
-            sl0 = slice(i * p, (i + 1) * p)
-            sl1 = slice((i + 1) * p, (i + 2) * p)
-            T[sl1, sl0] = Bi
-            T[sl0, sl1] = Bi.conj().T
+            w0, w1 = widths[i], widths[i + 1]
+            T[off + w0: off + w0 + w1, off: off + w0] = Bi
+            T[off: off + w0, off + w0: off + w0 + w1] = Bi.conj().T
+            off += w0
         kk = min(k, m)
         theta, S = eigh(T, subset_by_index=(0, kk - 1))
         res = np.linalg.norm(
-            np.asarray(B_list[-1]) @ S[m - p:, :], axis=0)
+            np.asarray(B_list[-1]) @ S[m - widths[-1]:, :], axis=0)
         omega = obs_health.omega_estimate(
             np.asarray(a_seq), np.asarray(b_seq),
             len(b_seq) - 1, len(b_seq)) \
             if obs_health.probes_enabled() else None
         _emit_trace("lanczos_block", total, m, theta, res, omega)
-        if m >= k and np.all(res < tol * np.maximum(1.0, np.abs(theta))):
-            converged = True
-            break
+        newly_done = 0
+        if targets is None:
+            if m >= k and np.all(res < tol * np.maximum(1.0,
+                                                        np.abs(theta))):
+                converged = True
+                break
+        else:
+            # heterogeneous convergence: every unfinished target judged
+            # against ITS OWN (k, tol) on the shared Ritz pairs; a
+            # converged target's result is snapshotted here and its
+            # column exits below
+            for t in targets:
+                if t.get("done"):
+                    continue
+                kt = min(t["k"], kk)
+                ok = m >= t["k"] and np.all(
+                    res[:kt] < t["tol"]
+                    * np.maximum(1.0, np.abs(theta[:kt])))
+                # a target whose OWN column budget is spent exits too —
+                # unconverged, exactly like its solo run would have: a
+                # batch must never bill a job more columns than its spec
+                # (and its admission pricing) allowed
+                spent = (not ok and t["max_iters"] is not None
+                         and total >= t["max_iters"])
+                if not ok and not spent:
+                    continue
+                t["done"] = True
+                t["snapshot"] = {
+                    "theta": np.asarray(theta[:kt]).copy(),
+                    "res": np.asarray(res[:kt]).copy(),
+                    "S": np.asarray(S[:, :kt]).copy(),
+                    "m": int(m), "iters": int(total),
+                    "converged": bool(ok)}
+                newly_done += 1
+                obs_emit("solver_column_converged"
+                         if ok else "solver_column_budget_exhausted",
+                         solver="lanczos_block",
+                         target_job_id=str(t.get("job_id") or ""),
+                         k=int(t["k"]), iters=int(total),
+                         basis_size=int(m), width=int(p_cur))
+            if all(t.get("done") for t in targets):
+                converged = all(t["snapshot"]["converged"]
+                                for t in targets)
+                break
         watchdog.report_omega(omega, total)
         # breakdown: the Krylov space closed (rank-deficient new block) —
         # with full reorth a deficient column is numerical noise, stop
@@ -784,26 +897,90 @@ def _lanczos_block_impl(
         if rdiag.min() < 1e-12 * max(rdiag.max(), 1.0):
             watchdog.breakdown(total, float(rdiag.min()), converged=False)
             break
-        if total + p > max_iters:
+        if total + p_cur > max_iters:
             break
         watchdog.check_stagnation(res, total)
+        if newly_done:
+            remaining = [t for t in targets if not t.get("done")]
+            p_new = max(len(remaining),
+                        max(t["k"] for t in remaining), 1)
+            if p_new < p_cur:
+                # Column exit via a COMPRESSION RESTART: simply dropping
+                # columns of the QR'd new block would discard genuine
+                # Krylov directions and silently break the residual
+                # bound (||B·s_last|| no longer accounts for the
+                # discarded component — measured: a 1e-10 claim with a
+                # 1e-6 true error).  Instead the basis is compressed to
+                # the p_new lowest Ritz vectors and the recurrence
+                # RESTARTS at the narrower width — restarted block
+                # Lanczos, every subsequent residual an exact recurrence
+                # residual again.  Finished targets' eigenvectors are
+                # materialized first (their snapshots reference the
+                # blocks this restart is about to drop).
+                if compute_eigenvectors:
+                    for t in targets:
+                        snap = t.get("snapshot")
+                        if snap is not None and "vecs" not in snap:
+                            snap["vecs"] = _assemble(snap["S"], snap["m"])
+                _, S_r = eigh(T, subset_by_index=(0, p_new - 1))
+                Q0, _ = jnp.linalg.qr(_ritz_block(S_r, m))
+                jax.block_until_ready(Q0)
+                blocks = [Q0.astype(dtype)]
+                A_list, B_list, widths = [], [], []
+                a_seq, b_seq = [], []      # ω table resets with the basis
+                obs_emit("solver_restart_narrow", solver="lanczos_block",
+                         iters=int(total), width=int(p_cur),
+                         new_width=int(p_new), basis_size=int(m),
+                         remaining=len(remaining))
+                p_cur = p_new
+                if blk_path is not None:
+                    mem_h.set(blk_path,
+                              int(sum(b.nbytes for b in blocks)))
+                j += 1
+                continue
         blocks.append(Qn)
         if blk_path is not None:
-            mem_h.set(blk_path, int(Q.nbytes) * len(blocks))
+            mem_h.set(blk_path, int(sum(b.nbytes for b in blocks)))
+        j += 1
 
-    kk = min(k, len(A_list) * p)
+    kk = min(k, sum(widths)) if widths else 0
+
     evecs = None
     if compute_eigenvectors and theta is not None:
-        Sj = jnp.asarray(S[:, :kk], dtype=dtype)
-        # S has len(A_list)·p rows; `blocks` may hold one extra (not yet
-        # projected) block when the loop ran to its last step
-        E = sum(blocks[i] @ Sj[i * p:(i + 1) * p]
-                for i in range(len(A_list)))
-        evecs = []
-        for i in range(kk):
-            e = E[:, i]
-            e = e / jnp.sqrt(jnp.real(jnp.vdot(e, e))).astype(dtype)
-            evecs.append(e.reshape(vec_shape) if vec_shape else e)
+        # `blocks` may hold one extra (not yet projected) block when the
+        # loop ran to its last step — _assemble() stops at the m-th row
+        evecs = _assemble(np.asarray(S[:, :kk]), sum(widths))
+
+    column_results = None
+    if targets is not None:
+        column_results = []
+        for t in targets:
+            snap = t.get("snapshot")
+            if snap is None and theta is not None:
+                # unfinished target: its best-so-far reading at the final
+                # basis size, marked unconverged
+                kt = min(t["k"], kk)
+                snap = {"theta": np.asarray(theta[:kt]),
+                        "res": np.asarray(res[:kt]),
+                        "S": np.asarray(S[:, :kt]),
+                        "m": int(sum(widths)), "iters": int(total),
+                        "converged": False}
+            entry = {"job_id": t.get("job_id"), "k": int(t["k"]),
+                     "tol": float(t["tol"]),
+                     "converged": bool(snap and snap["converged"]),
+                     "eigenvalues": np.asarray(snap["theta"])
+                     if snap else np.zeros(0),
+                     "residuals": np.asarray(snap["res"])
+                     if snap else np.zeros(0),
+                     "iters": int(snap["iters"]) if snap else 0,
+                     "basis_size": int(snap["m"]) if snap else 0}
+            if compute_eigenvectors and snap is not None:
+                # materialized at a narrowing restart when the snapshot's
+                # blocks were dropped; assembled here otherwise
+                entry["eigenvectors"] = snap.get("vecs") \
+                    or _assemble(np.asarray(snap["S"]), snap["m"])
+            column_results.append(entry)
+
     obs_emit("solver_end", solver="lanczos_block", iters=int(total),
              converged=bool(converged),
              eigenvalues=[float(t) for t in np.atleast_1d(theta)[:kk]]
@@ -820,6 +997,7 @@ def _lanczos_block_impl(
         first_block_seconds=first_block_s,
         first_block_iters=first_block_iters,
         steady_seconds=steady_s,
+        column_results=column_results,
     )
 
 

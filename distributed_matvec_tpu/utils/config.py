@@ -177,6 +177,20 @@ class RuntimeConfig:
     #   process instead of silently filling the cache disk (explicit
     #   structure_cache= paths are never capped)
 
+    # -- solve service (serve/, DESIGN.md §26) ------------------------------
+    serve_pool_gb: float = 2.0             # engine-pool byte budget
+    #   (DMT_SERVE_POOL_GB): resident engines (device tables + host-RAM
+    #   streamed plans) beyond it are evicted LRU — the artifact_max_gb
+    #   analog for WARM engines rather than on-disk sidecars
+    serve_block_width: int = 6             # max jobs packed into one
+    #   batched lanczos_block call (DMT_SERVE_BLOCK_WIDTH): the multi-RHS
+    #   block width cap — wider amortizes gathers further but raises the
+    #   per-step cost every still-running job pays
+    serve_accept_horizon_s: float = 30.0   # admission verdict boundary
+    #   (DMT_SERVE_ACCEPT_HORIZON_S): a job whose priced queue-wait ETA
+    #   exceeds this is admitted with verdict "queue" (ETA attached)
+    #   instead of "accept"; jobs that do not fit at all are rejected
+
 
 
 _ENV_PREFIX = "DMT_"

@@ -126,6 +126,49 @@ if os.environ.get("DMT_MH_PIPE") is not None:
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_SERVE"):
+    # Solve-service leg (tests/test_serve.py): two SAME-BASIS jobs
+    # submitted to a scheduler whose engine pool runs over a RANK-LOCAL
+    # mesh (the CPU backend cannot run cross-process computations — same
+    # constraint as every fast leg here) inside a real 2-process
+    # jax.distributed job.  The jobs must provably share ONE engine
+    # build: the pool reports builds == 1 and the parent asserts exactly
+    # one engine_init event per rank.  Correctness still asserted (both
+    # jobs' E0 against the exact ring ground state) so a broken batch
+    # cannot masquerade as a sharing win.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.serve import (EnginePool, JobQueue,
+                                              JobSpec, Scheduler)
+
+    mesh = make_mesh(devices=jax.local_devices())
+    pool = EnginePool(mesh=mesh)
+    # block_width=1: the two jobs run as two consecutive solo batches, so
+    # the second MUST come from the pool (builds=1, hits=1) — the
+    # sharing-across-batches contract, stronger than one 2-wide batch
+    sched = Scheduler(queue=JobQueue(), pool=pool, rates=None,
+                      block_width=1)
+    specs = [JobSpec(job_id=f"mh{i}",
+                     basis={"number_spins": N_SPINS,
+                            "hamming_weight": N_SPINS // 2},
+                     k=1, tol=1e-9, max_iters=200, mode="ell",
+                     n_devices=len(jax.local_devices()))
+             for i in range(2)]
+    for s in specs:
+        sched.submit(s)
+    n_done = sched.drain(scan_spool=False)
+    assert n_done == 2, n_done
+    for s in specs:
+        rec = sched.queue.result(s.job_id)
+        assert rec["status"] == "done", rec
+        e0 = rec["eigenvalues"][0]
+        assert abs(e0 / 4 - E0_OVER_4) < 1e-7, (s.job_id, e0)
+    assert pool.builds == 1 and pool.hits == 1, (pool.builds, pool.hits)
+    print(f"[p{pid}] SERVE_OK builds={pool.builds} hits={pool.hits}",
+          flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

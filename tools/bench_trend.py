@@ -42,7 +42,9 @@ import time
 from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-import obs_report  # noqa: E402  (direction rules live in ONE place)
+import obs_report  # noqa: E402  (direction rules live in ONE shared
+#   table, distributed_matvec_tpu/obs/directions.py, loaded by
+#   obs_report — both tools judge every metric through the same entry)
 
 KIND = "bench_trend"
 
@@ -59,6 +61,10 @@ METRIC_WHITELIST = (
     "compress_steady_speedup", "compress_rel_err", "compress_drift_max",
     "pipelined_steady_apply_ms", "pipelined_steady_speedup",
     "barrier_ms", "overlap_fraction", "pipeline_depth",
+    "serve_jobs", "serve_jobs_done", "serve_wall_s",
+    "serve_solves_per_min", "serve_p50_latency_ms",
+    "serve_p99_latency_ms", "serve_engine_builds", "serve_engine_hits",
+    "serve_batch_speedup", "serve_e0_max_rel_err", "solo_wall_s",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -75,11 +81,17 @@ METRIC_WHITELIST = (
 #: both cost-like under obs_report's direction rule) guards the overlap
 #: win: a PR that quietly re-exposes the staging latency the pipeline
 #: hides fails the gate even when the sequential walls hold.
+#: The serve pair (``serve_solves_per_min`` higher-is-better via the
+#: shared direction table in distributed_matvec_tpu/obs/directions.py,
+#: ``serve_p99_latency_ms`` cost-like) guards the solve service's
+#: throughput/latency: a PR that quietly halves serving throughput or
+#: doubles tail latency fails the gate even when single-solve walls hold.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s", "compress_rel_err",
                 "compress_drift_max", "barrier_ms",
-                "pipelined_steady_apply_ms")
+                "pipelined_steady_apply_ms",
+                "serve_solves_per_min", "serve_p99_latency_ms")
 
 #: Absolute noise floors per gated metric: a baseline below the floor is
 #: scheduler jitter, not a trajectory (``barrier_ms`` on a healthy

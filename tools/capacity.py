@@ -321,6 +321,76 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
     return out
 
 
+#: Solve-length model for :func:`price_job`: Lanczos columns to
+#: convergence per requested eigenpair (Heisenberg-class spectra reach
+#: 1e-10 residuals well inside this on the bench configs).  A documented
+#: model constant, same standing as ``LIVE_FRACTION`` — the measured
+#: trend record wins once the service has run the config.
+EST_COLUMNS_PER_EIGENPAIR = 48
+
+
+def price_job(spec, calibration: Optional[dict] = None,
+              hbm_gb: float = 16.0, host_ram_gb: float = 64.0,
+              utilization: float = DEFAULT_UTILIZATION,
+              vectors: int = 3) -> dict:
+    """Admission pricing for ONE job spec — the importable API the solve
+    service's scheduler (``distributed_matvec_tpu/serve/scheduler.py``)
+    and its tests call instead of shelling out to the CLI.
+
+    ``spec`` is a mapping with ``n_states``/``num_terms``/``mode``/
+    ``n_devices`` (+ optional ``pair``/``k``/``max_iters``/``t0``) — what
+    ``JobSpec.pricing()`` produces.  ``calibration`` is a rates dict from
+    :func:`load_rate_calibration` (or any mapping with
+    ``gather_rows_per_s`` etc.); None prices memory fits only.
+
+    Returns ``{est_apply_ms, est_solve_s, fits, est_iters, reason}``:
+    ``fits`` is the memory verdict for the spec's mode on its mesh (the
+    streamed mode's host-plan budget included), ``est_apply_ms`` the
+    calibrated roofline apply estimate (None without rates), and
+    ``est_solve_s`` that estimate times the modeled iteration count
+    (``EST_COLUMNS_PER_EIGENPAIR``·k, capped by the spec's own
+    ``max_iters``).  A spec whose dimension is unknown before the basis
+    builds (yaml submissions) is passed through un-priced with
+    ``fits=True`` — admission stays optimistic rather than rejecting
+    blind."""
+    n_states = spec.get("n_states")
+    if not n_states:
+        return {"est_apply_ms": None, "est_solve_s": None, "fits": True,
+                "est_iters": None, "priced": False,
+                "reason": "unpriced (dimension unknown before basis build)"}
+    mode = str(spec.get("mode") or "ell")
+    num_terms = int(spec.get("num_terms") or 1)
+    k = max(int(spec.get("k") or 1), 1)
+    report = plan(int(n_states), num_terms,
+                  int(spec.get("t0") or num_terms),
+                  bool(spec.get("pair")), float(hbm_gb),
+                  max(int(spec.get("n_devices") or 1), 1),
+                  vectors, max(k, 2), utilization=utilization,
+                  host_ram_gb=float(host_ram_gb), rates=calibration)
+    entry = report["modes"].get(mode)
+    if entry is None:
+        return {"est_apply_ms": None, "est_solve_s": None, "fits": False,
+                "est_iters": None, "priced": False,
+                "reason": f"unknown engine mode {mode!r}"}
+    fits = bool(entry["fits_n_states"])
+    est_apply_ms = entry.get("est_apply_ms")
+    est_iters = min(EST_COLUMNS_PER_EIGENPAIR * k,
+                    int(spec.get("max_iters") or 10 ** 9))
+    # 6 decimals: a sub-millisecond solve must price > 0, or a long
+    # queue of tiny jobs would never grow the admission backlog
+    est_solve_s = (round(est_apply_ms * est_iters / 1e3, 6)
+                   if est_apply_ms is not None else None)
+    reason = "" if fits else (
+        f"{mode} needs {entry['devices_needed_for_n_states']} device(s) "
+        f"for {int(n_states):,} rows, mesh has "
+        f"{report['inputs']['n_devices']}")
+    return {"est_apply_ms": est_apply_ms, "est_solve_s": est_solve_s,
+            "fits": fits, "est_iters": est_iters, "priced": True,
+            "reason": reason,
+            "bytes_per_row": entry["bytes_per_row"],
+            "max_rows_per_device": entry["max_rows_per_device"]}
+
+
 def recommend(report: dict, target_n: Optional[int]) -> dict:
     """Mode/shard recommendation for ``target_n`` (or the input basis):
     the cheapest-per-apply mode (ell > compact > streamed > fused

@@ -113,14 +113,26 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-# Metrics where a LOWER value in the new run is the regression (rates,
-# speedups); everything else numeric is treated as cost-like (ms, seconds,
-# bytes, iteration counts) where HIGHER is the regression — which is the
-# DELIBERATE registration for the lossy-tier drift metrics
-# (compress_rel_err, compress_drift_max): numerical error growing is the
-# regression, so they gate correctly under the default rule.
-_HIGHER_IS_BETTER = ("iters_per_s", "speedup", "_rate", "hit_rate",
-                     "compress_ratio", "overlap_fraction")
+# Metric directions live in ONE shared table
+# (distributed_matvec_tpu/obs/directions.py) consumed by every gate
+# (this tool, bench_trend via this tool, the check scripts) —
+# registering a new metric's direction happens exactly once there.  The
+# module is loaded by FILE so this standalone reader never imports the
+# package (and therefore never initializes a JAX backend just to read
+# JSONL).
+def _load_directions():
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "distributed_matvec_tpu", "obs", "directions.py")
+    spec = importlib.util.spec_from_file_location("dmt_obs_directions",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_is_higher_better = _load_directions().is_higher_better
 
 _DEFAULT_GATE = ("device_ms",)
 
@@ -132,10 +144,6 @@ _MEMORY_GATE = ("table_bytes", "executable_temp_bytes",
 # the phase gate (`diff --phases`): every per-phase bench metric
 # (phase_<name>_bytes / _gathers / _ms) — all cost-like, prefix-matched
 _PHASE_GATE = ("phase_*",)
-
-
-def _is_higher_better(metric: str) -> bool:
-    return any(tag in metric for tag in _HIGHER_IS_BETTER)
 
 
 # ---------------------------------------------------------------------------
@@ -1165,6 +1173,12 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
               "stalls": 0}
     drift = None
     ident: Dict[str, str] = {}
+    # solve-service state (serve/, DESIGN.md §26): latest status per
+    # job_id, admission verdict tallies, last engine_pool occupancy
+    serve_jobs: Dict[str, str] = {}
+    serve_admissions: Dict[str, int] = {}
+    serve_last_admission = None
+    serve_pool = None
     for ev in events:
         r = _rank_of(ev)
         kind = ev.get("kind")
@@ -1213,6 +1227,23 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
         elif kind == "compress_drift":
             if ev.get("rel_err") is not None:
                 drift = float(ev["rel_err"])
+        elif kind == "job_event":
+            jid = str(ev.get("job_id") or "?")
+            serve_jobs[jid] = str(ev.get("status"))
+        elif kind == "admission":
+            v = str(ev.get("verdict"))
+            serve_admissions[v] = serve_admissions.get(v, 0) + 1
+            serve_last_admission = {
+                "job_id": str(ev.get("job_id") or "?"), "verdict": v,
+                "eta_s": ev.get("eta_s"),
+                "est_solve_s": ev.get("est_solve_s")}
+        elif kind == "engine_pool":
+            serve_pool = {
+                "engines": ev.get("engines"),
+                "pool_bytes": ev.get("pool_bytes"),
+                "pool_max_bytes": ev.get("pool_max_bytes"),
+                "builds": ev.get("builds"), "hits": ev.get("hits"),
+                "evictions": ev.get("evictions")}
     n_events = len(events)
     if base:
         n_events += base["n_events"]
@@ -1223,11 +1254,20 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
         for k, v in base["health"].items():
             health[k] += v
     strag = straggler_report(events, offsets)
+    serve = None
+    if serve_jobs or serve_admissions or serve_pool:
+        counts: Dict[str, int] = {}
+        for st in serve_jobs.values():
+            counts[st] = counts.get(st, 0) + 1
+        serve = {"jobs": counts, "n_jobs": len(serve_jobs),
+                 "admissions": serve_admissions,
+                 "last_admission": serve_last_admission,
+                 "pool": serve_pool}
     return {"ident": ident, "ranks": ranks, "n_events": n_events,
             "now": now, "window_s": window_s, "per_rank": per_rank,
             "phases": phases_summary(events), "solver": solver,
             "solver_done": solver_done, "straggler": strag,
-            "health": health, "drift": drift}
+            "health": health, "drift": drift, "serve": serve}
 
 
 def _fmt_rate(n: int, window_s: float) -> str:
@@ -1312,6 +1352,38 @@ def render_watch(state: dict) -> str:
                     f"host ledger {_fmt_bytes(row['host'])})")
     if mems:
         lines.append("memory    " + " | ".join(mems))
+    serve = state.get("serve")
+    if serve:
+        # the solve-service queue panel (lines appended, never reshaped
+        # — the golden frame of serve-less runs is unchanged)
+        order = ("queued", "running", "done", "failed", "rejected")
+        jobs = serve.get("jobs") or {}
+        parts = [f"{jobs[s]} {s}" for s in order if jobs.get(s)]
+        parts += [f"{n} {s}" for s, n in sorted(jobs.items())
+                  if s not in order]
+        adm = serve.get("admissions") or {}
+        adm_txt = ", ".join(f"{v} {adm[v]}" for v in
+                            ("accept", "queue", "reject") if adm.get(v)) \
+            or "-"
+        last = serve.get("last_admission")
+        last_txt = ""
+        if last:
+            eta = (f" eta {last['eta_s']:.1f}s"
+                   if last.get("eta_s") is not None else "")
+            last_txt = (f" (last {last['job_id']}: "
+                        f"{last['verdict']}{eta})")
+        lines.append(f"serve     {serve['n_jobs']} job(s): "
+                     + (", ".join(parts) if parts else "-")
+                     + f" | admissions: {adm_txt}{last_txt}")
+        pool = serve.get("pool")
+        if pool:
+            lines.append(
+                f"pool      {pool.get('engines', 0)} engine(s), "
+                f"{_fmt_bytes(pool.get('pool_bytes'))} / "
+                f"{_fmt_bytes(pool.get('pool_max_bytes'))} | "
+                f"builds {pool.get('builds', 0)}, "
+                f"hits {pool.get('hits', 0)}, "
+                f"evictions {pool.get('evictions', 0)}")
     return "\n".join(lines)
 
 
