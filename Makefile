@@ -14,7 +14,7 @@ HEALTH_THRESHOLD ?= 0.02
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
 	roofline-check compress-check trace-check pipeline-check \
-	serve-check clean
+	serve-check elastic-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -28,6 +28,7 @@ check:
 	$(MAKE) trace-check
 	$(MAKE) serve-check
 	$(MAKE) fault-check
+	$(MAKE) elastic-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -161,6 +162,22 @@ serve-check:
 # Deterministic seeds, < 90 s on the CPU rig.
 fault-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/fault_check.py
+
+# Elastic-solve gate (tools/elastic_check.py): topology-portable
+# checkpoints on the 2↔4 virtual-device CPU rig — SIGKILL a 4-device
+# solve mid-iteration and resume on 2 (and the reverse), resumed E0 ==
+# uninterrupted E0 at rtol 1e-12 with a solver_checkpoint{resharded}
+# event; a chain_16 solve rides a full shrink+grow cycle under a dumb
+# supervisor with no operator intervention; matching-D restores stay
+# reshard-free; an injected ckpt_reshard fault degrades the restore to a
+# fresh (still-correct) solve; a SIGTERMed 2-device solve service drains
+# its respooled jobs on 1 device with admission re-priced against the
+# live capacity; streamed plans rebuilt at D′ emit plan_reshard; and
+# resume_reshard_s / resume_rebuild_plan_s gate in bench_trend
+# (pass on repeat, fire on a synthetic 10x regression).  ~90 s warm
+# on CPU, up to ~4 min cold.
+elastic-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/elastic_check.py
 
 # Numerical-health gate (tools/health_check.py): chain-16 smoke applies
 # with probes on vs off in ONE process (same warm engine — cross-process

@@ -26,6 +26,7 @@ consumer can assemble the padded ``[D, M]`` device array directly (see
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import numpy as np
@@ -34,7 +35,8 @@ from ..enumeration.host import shard_index
 
 __all__ = ["stream_block_to_shards", "save_hashed_vector",
            "save_hashed_vectors", "load_hashed_shard",
-           "load_hashed_meta", "hashed_vector_counts"]
+           "load_hashed_meta", "hashed_vector_counts",
+           "hashed_shard_reader"]
 
 _CHUNK = 1 << 20
 
@@ -240,6 +242,82 @@ def _fingerprint_ok(f, expected_fingerprint: Optional[str]) -> bool:
         return False
     return (str(f["ckpt_meta"].attrs.get("fingerprint", ""))
             == expected_fingerprint)
+
+
+def _generation_ok(f, match_meta: Optional[dict]) -> bool:
+    """True when the file's own ``/ckpt_meta`` generation scalars
+    (``m``, ``total_iters``) agree with the checkpoint metadata the
+    caller already selected.  Per-rank ``.r*`` files are written without
+    a barrier, so a crash between rank saves leaves files of MIXED
+    generations that all pass the fingerprint filter — and a thick
+    restart SHRINKS ``m``, so a stale file can satisfy every shard fetch
+    of a newer, smaller checkpoint.  Fetching from such a file would
+    silently splice old basis rows into the resumed solve."""
+    if match_meta is None:
+        return True
+    if "ckpt_meta" not in f:
+        return False
+    attrs = f["ckpt_meta"].attrs
+    for k in ("m", "total_iters"):
+        if k not in match_meta:
+            continue
+        if k not in attrs or int(attrs[k]) != int(match_meta[k]):
+            return False
+    return True
+
+
+@contextlib.contextmanager
+def hashed_shard_reader(path: str,
+                        expected_fingerprint: Optional[str] = None,
+                        match_meta: Optional[dict] = None):
+    """Scan-once, open-once shard reader over ``path`` and its per-rank
+    ``path.r*`` files.  Candidates are globbed, opened, and filtered ONE
+    time — by ``expected_fingerprint`` (the stale-file filter of
+    :func:`load_hashed_shard`) AND by generation agreement of each
+    file's own ``/ckpt_meta`` against ``match_meta``, the metadata the
+    caller already selected — then the yielded ``fetch(d, name)`` serves
+    every per-(row, shard) read from the already-open files.
+
+    A checkpoint restore reads O(m·D) shard slices; per-call
+    :func:`load_hashed_shard` scans would bill ~m·D glob+open+close
+    cycles to the trend-gated ``resume_reshard_s``.  The generation
+    filter is a correctness matter, not an optimization: barrier-free
+    per-rank saves mean mixed-generation ``.r*`` files can coexist under
+    one fingerprint, and a fetch that fell through to a stale file would
+    splice rows of a different Krylov basis into the resume.  A shard
+    absent from every same-generation file raises ``KeyError`` — the
+    caller's existing incomplete-checkpoint degrade path."""
+    import glob
+
+    import h5py
+
+    files = []
+    try:
+        for cand in [path] + sorted(glob.glob(f"{path}.r*")):
+            try:
+                f = h5py.File(cand, "r")
+            except OSError:
+                continue
+            if (_fingerprint_ok(f, expected_fingerprint)
+                    and _generation_ok(f, match_meta)):
+                files.append(f)
+            else:
+                f.close()
+
+        def fetch(d: int, name: str = "v") -> np.ndarray:
+            key = f"vector_shards/{name}"
+            sd = str(d)
+            for f in files:
+                if key in f and sd in f[key]:
+                    return f[key][sd][...]
+            raise KeyError(
+                f"shard {d} of {name!r} not found under {path}(.r*) in "
+                "the restored checkpoint generation")
+
+        yield fetch
+    finally:
+        for f in files:
+            f.close()
 
 
 def load_hashed_shard(path: str, d: int, name: str = "v",

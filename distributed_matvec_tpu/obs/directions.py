@@ -14,7 +14,11 @@ name contains one of :data:`HIGHER_IS_BETTER_TAGS` is higher-is-better,
 everything else numeric is cost-like (growth is the regression) — which
 is the DELIBERATE registration for error metrics like
 ``compress_rel_err``/``compress_drift_max``: numerical error growing is
-the regression, so they gate correctly under the default rule.
+the regression, so they gate correctly under the default rule — and for
+the elastic-resume walls ``resume_reshard_s`` / ``resume_rebuild_plan_s``
+(``make elastic-check``): time spent redistributing a checkpoint or
+rebuilding a per-D′ plan on resume is a cost, so growth gates under the
+default rule; register them here (by falling through) exactly once.
 """
 
 from __future__ import annotations

@@ -73,6 +73,7 @@ sorted (*block*) order.  Batches ``[D, M, k]`` are supported end-to-end.
 from __future__ import annotations
 
 import math
+import re
 import time
 from typing import Optional
 
@@ -106,6 +107,20 @@ from .mesh import (SHARD_AXIS, make_mesh, pcast_varying,
 from .shuffle import HashedLayout
 
 __all__ = ["DistributedEngine"]
+
+
+def _sidecar_name(d: int, kind: str) -> str:
+    """The per-D plan-sidecar naming convention — the ONE definition.
+    ``_structure_sidecar`` / ``_stream_sidecar`` build names through it
+    and ``_emit_plan_reshard`` parses device counts back out through the
+    inverse ``_SIDECAR_RE`` right below; a rename happens here and in
+    that regex, nowhere else."""
+    return f".dist{d}.{kind}.h5"
+
+
+#: inverse of :func:`_sidecar_name` — captures the device count of a
+#: sidecar of either kind; keep in lockstep with the format above
+_SIDECAR_RE = re.compile(r"\.dist(\d+)\.(?:stream|structure)\.h5$")
 
 
 def _round_up(n: int, b: int) -> int:
@@ -515,6 +530,11 @@ class DistributedEngine:
         #: (explicit path or the default artifact cache) rather than a
         #: fresh host-coordinated build.
         self.structure_restored = False
+        # the CALLER's cache path, before per-mode resolution: sidecar
+        # names bake in the device count (`.dist{D}.…`), so this is where
+        # a topology change (resume at D′ next to a D-era sidecar) is
+        # detectable — see _emit_plan_reshard
+        cache_arg = structure_cache
         soft_save = structure_cache is None
         if mode in ("ell", "compact"):
             structure_cache = self._resolve_structure_cache(structure_cache)
@@ -524,6 +544,7 @@ class DistributedEngine:
             record_structure_cache(self.structure_restored,
                                    structure_cache is not None)
             if not self.structure_restored:
+                _t_build = time.perf_counter()
                 with self.timer.scope("build_plan"), \
                         annotate("engine_init/build_plan"):
                     try:
@@ -536,6 +557,8 @@ class DistributedEngine:
                                     phase="init",
                                     n_states=int(self.n_states))
                 self._save_structure(structure_cache, soft=soft_save)
+                self._emit_plan_reshard(cache_arg,
+                                        time.perf_counter() - _t_build)
             self._matvec = self._make_ell_matvec()
             self._checked.add(None)  # static plan: no data-dependent capacity
         elif mode == "compact":
@@ -576,6 +599,7 @@ class DistributedEngine:
                         f"compact mode needs a single off-diagonal "
                         f"magnitude, found {vals[:5]}; use mode='ell'")
                 self._c_W = float(vals[0]) if vals.size else 0.0
+                _t_build = time.perf_counter()
                 with self.timer.scope("build_plan"), \
                         annotate("engine_init/build_plan"):
                     try:
@@ -588,6 +612,8 @@ class DistributedEngine:
                                     phase="init",
                                     n_states=int(self.n_states))
                 self._save_structure(structure_cache, soft=soft_save)
+                self._emit_plan_reshard(cache_arg,
+                                        time.perf_counter() - _t_build)
                 self._c_n_all_shards = None   # only needed by the save above
             self._matvec = self._make_compact_matvec()
             self._checked.add(None)  # static plan: no data-dependent capacity
@@ -666,6 +692,7 @@ class DistributedEngine:
                 record_structure_cache(self.structure_restored,
                                        stream_cache is not None)
                 if not self.structure_restored:
+                    _t_build = time.perf_counter()
                     with self.timer.scope("build_plan"), \
                             annotate("engine_init/build_plan"):
                         try:
@@ -679,6 +706,8 @@ class DistributedEngine:
                                         phase="init",
                                         n_states=int(self.n_states))
                     self._save_stream_plan(stream_cache, soft=soft_save)
+                    self._emit_plan_reshard(cache_arg,
+                                            time.perf_counter() - _t_build)
                 self._upload_codec_tables()
                 self._register_stream_plan()
                 import weakref
@@ -1222,7 +1251,40 @@ class DistributedEngine:
     def _structure_sidecar(self, path: str) -> str:
         """Distinct from LocalEngine's sidecar (and per mesh size) so local
         and distributed checkpoints for the same basis don't thrash."""
-        return f"{path}.dist{self.n_devices}.structure.h5"
+        return path + _sidecar_name(self.n_devices, "structure")
+
+    def _emit_plan_reshard(self, cache_path: Optional[str],
+                           rebuild_s: float) -> None:
+        """Make the topology-driven plan-cache miss OBSERVABLE.
+
+        Plan sidecars are per-D by fingerprint AND filename
+        (``.dist{D}.…``) — bit-correct on a D→D′ resume by construction
+        (the engine rebuilds from structure rather than misreading a
+        stale ``*.dist{D}.stream.h5``), but previously indistinguishable
+        from a cold start.  When this build's cache MISSED and a sidecar
+        for the same base path at a DIFFERENT device count sits on disk,
+        the miss was a topology change: emit one ``plan_reshard`` event
+        carrying the old topologies and the rebuild wall, the
+        ``resume_rebuild_plan_s`` the elastic gate trend-tracks.  Only
+        explicit cache paths are inspectable (the default artifact cache
+        is content-addressed per fingerprint — no sibling to find)."""
+        if not cache_path:
+            return
+        import glob
+        import os
+
+        seen = set()
+        for cand in glob.glob(glob.escape(cache_path) + ".dist*"):
+            m = _SIDECAR_RE.search(os.path.basename(cand))
+            if m:
+                seen.add(int(m.group(1)))
+        seen.discard(self.n_devices)
+        if not seen:
+            return
+        emit("plan_reshard", engine="distributed", mode=self.mode,
+             d_from=sorted(int(d) for d in seen),
+             d_to=int(self.n_devices),
+             rebuild_s=round(float(rebuild_s), 6))
 
     def _structure_fingerprint(self) -> str:
         if getattr(self, "_fp_cache", None) is not None:
@@ -1486,7 +1548,7 @@ class DistributedEngine:
     _STREAM_ARRAYS = ("dest", "coeff", "ridx", "rok")
 
     def _stream_sidecar(self, path: str) -> str:
-        return f"{path}.dist{self.n_devices}.stream.h5"
+        return path + _sidecar_name(self.n_devices, "stream")
 
     def _stream_nchunks(self) -> int:
         B = self.batch_size

@@ -84,6 +84,42 @@ class HeartbeatWatchdog:
     def _path(self, r: int) -> str:
         return os.path.join(self.dir, f"rank_{r}.hb")
 
+    def _age_out_departed(self) -> None:
+        """Remove beat files of ranks OUTSIDE this run's rank set whose
+        beat is stale past the timeout — leftovers of a LARGER earlier
+        topology (an elastic resize 4→2 leaves rank_2/rank_3 files
+        behind).  The scan below is scoped to ``range(n_ranks)`` so a
+        departed rank can never be reported stalled, but the stale files
+        must still be swept: a later GROW back to the old size would
+        otherwise see beats older than its own start and burn its whole
+        startup grace on ghosts.  Staleness (``now − mtime >
+        timeout_s``), NOT age relative to this watchdog, is the test: a
+        LIVE concurrent larger run's peers beat every ``interval_s``, so
+        their files always look older than a freshly constructed
+        watchdog yet must never be deleted — a sweep would open a
+        one-beat window in which that run's scan sees the file missing
+        and aborts with the very spurious exit-76 this sweep exists to
+        prevent.  A not-yet-stale file of a genuinely departed rank is
+        simply left for the regrow's startup grace to absorb."""
+        import re
+
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        now = time.time()
+        pat = re.compile(r"^rank_(\d+)\.hb$")
+        for name in names:
+            m = pat.match(name)
+            if m is None or int(m.group(1)) < self.n_ranks:
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                if now - os.path.getmtime(path) > self.timeout_s:
+                    os.remove(path)
+            except OSError:
+                pass        # raced with another sweeper — fine
+
     def beat(self) -> None:
         """Touch this rank's beat file (atomic replace: a reader never
         sees a half-written beat)."""
@@ -105,7 +141,14 @@ class HeartbeatWatchdog:
         the same dir: a relaunch-after-preemption must not be killed by
         its own dead predecessor's files) — is only counted stale once
         the watchdog itself has been alive past the timeout (startup
-        grace: ranks come up at different times)."""
+        grace: ranks come up at different times).
+
+        The scan is scoped to THIS run's rank set (``range(n_ranks)``):
+        after an elastic resize, stale beat files of departed ranks —
+        rank_2/rank_3 after a 4→2 shrink — are outside the set by
+        construction and can never trigger a spurious ``stall_report`` /
+        exit-76; :meth:`start` additionally ages the old files out so a
+        later regrow does not meet its predecessors' ghosts."""
         now = time.time()
         ages = {}
         stalled = []
@@ -177,6 +220,7 @@ class HeartbeatWatchdog:
         if self.n_ranks <= 1:
             return self          # nothing to watch — stay inert
         if self._thread is None:
+            self._age_out_departed()
             self.beat()          # first beat synchronously: peers see us
             self._thread = threading.Thread(
                 target=self._loop, name="dmt-heartbeat", daemon=True)

@@ -45,19 +45,30 @@ def build_operator(spec: JobSpec):
     return heisenberg_from_edges(basis, edges)
 
 
-def build_engine(spec: JobSpec, mesh=None):
+def build_engine(spec: JobSpec, mesh=None, live_devices=None):
     """One engine for the spec: LocalEngine for single-device non-streamed
     jobs, DistributedEngine otherwise (``mesh`` — e.g. a rank-local mesh
-    on the 2-proc CPU rig — wins over ``n_devices``)."""
+    on the 2-proc CPU rig — wins over ``n_devices``).
+
+    ``live_devices`` clamps the spec's requested mesh to the CURRENT
+    topology: a spec respooled from a service that ran at D devices must
+    still build after a relaunch at D′ < D (the elastic-fleet contract —
+    the job re-admits and runs on what exists, it does not crash asking
+    for departed hardware)."""
     op = build_operator(spec)
-    if mesh is None and spec.n_devices in (0, 1) \
-            and spec.mode != "streamed":
+    n_devices = int(spec.n_devices or 0)
+    if live_devices is not None and n_devices > int(live_devices):
+        obs_emit("engine_clamp", job_id=spec.job_id,
+                 requested_devices=n_devices,
+                 live_devices=int(live_devices))
+        n_devices = int(live_devices)
+    if mesh is None and n_devices in (0, 1) and spec.mode != "streamed":
         from ..parallel.engine import LocalEngine
         return LocalEngine(op, mode=spec.mode)
     from ..parallel.distributed import DistributedEngine
     return DistributedEngine(op, mesh=mesh,
                              n_devices=None if mesh is not None
-                             else (spec.n_devices or 1),
+                             else (n_devices or 1),
                              mode=spec.mode)
 
 
@@ -74,21 +85,39 @@ def engine_bytes(eng) -> int:
 
 
 class EnginePool:
-    """LRU of warm engines keyed by engine fingerprint."""
+    """LRU of warm engines keyed by engine fingerprint.
+
+    ``live_devices`` (default: the mesh size, else
+    ``jax.local_device_count()`` at acquire time) is the pool's view of
+    the CURRENT topology: a warm engine whose mesh no longer fits —
+    built at D, the fleet shrank to D′ < D — is dropped on its next
+    acquire and rebuilt clamped to the live capacity, instead of
+    dispatching collectives onto departed devices."""
 
     def __init__(self, max_bytes: Optional[int] = None, mesh=None,
-                 builder: Optional[Callable] = None):
+                 builder: Optional[Callable] = None,
+                 live_devices: Optional[int] = None):
         if max_bytes is None:
             max_bytes = int(get_config().serve_pool_gb * 1e9)
         self.max_bytes = int(max_bytes)
         self.mesh = mesh
-        self._builder = builder or (lambda spec: build_engine(spec,
-                                                              mesh=self.mesh))
+        self.live_devices = live_devices
+        self._builder = builder or (lambda spec: build_engine(
+            spec, mesh=self.mesh, live_devices=self.live_device_count()))
         self._engines: "OrderedDict[str, object]" = OrderedDict()
         self._bytes: dict = {}
         self.builds = 0
         self.hits = 0
         self.evictions = 0
+
+    def live_device_count(self) -> int:
+        """The current topology the pool serves on."""
+        if self.live_devices is not None:
+            return int(self.live_devices)
+        if self.mesh is not None:
+            return int(self.mesh.devices.size)
+        import jax
+        return int(jax.local_device_count())
 
     # -- introspection -----------------------------------------------------
 
@@ -114,6 +143,22 @@ class EnginePool:
         the NEXT insertion)."""
         key = spec.engine_key()
         eng = self._engines.get(key)
+        if eng is not None and not self._mesh_ok(eng, spec):
+            # the fleet resized under a warm engine: its mesh spans
+            # devices that no longer exist (shrink), OR it was built
+            # clamped during an earlier shrink and the fleet has since
+            # regrown (a 1-device engine must not serve a spec that
+            # would get 4 today — admission prices the LIVE capacity,
+            # the engine must match it) — drop and rebuild
+            self._engines.pop(key, None)
+            freed = self._bytes.pop(key, 0)
+            self.evictions += 1
+            self._event("evict", key, freed_bytes=int(freed),
+                        reason="mesh_mismatch",
+                        engine_devices=int(getattr(eng, "n_devices", 1)
+                                           or 1),
+                        live_devices=self.live_device_count())
+            eng = None
         if eng is not None:
             self._engines.move_to_end(key)
             self.hits += 1
@@ -126,6 +171,21 @@ class EnginePool:
         self._evict(keep=key)
         self._event("build", key)
         return eng
+
+    def _mesh_ok(self, eng, spec: JobSpec) -> bool:
+        """Whether a warm engine's mesh matches what ``spec`` would be
+        built at TODAY: not spanning departed devices (shrink), and not
+        smaller than ``min(spec.n_devices, live)`` (an engine clamped
+        during a shrink must be rebuilt once the fleet regrows, or the
+        pool serves under-sized engines forever while admission prices
+        the full live capacity).  With a fixed ``mesh`` supplied, builds
+        always use that mesh, so both conditions hold by construction."""
+        live = self.live_device_count()
+        have = int(getattr(eng, "n_devices", 1) or 1)
+        if have > live:
+            return False
+        want = int(spec.n_devices or 0)
+        return not (want and have < min(want, live))
 
     def _evict(self, keep: str) -> None:
         while self.total_bytes() > self.max_bytes and len(self._engines) > 1:

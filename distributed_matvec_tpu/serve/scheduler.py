@@ -75,10 +75,15 @@ class Scheduler:
                  block_width: Optional[int] = None,
                  hbm_gb: float = 16.0, host_ram_gb: float = 64.0,
                  accept_horizon_s: Optional[float] = None,
-                 mesh=None):
+                 mesh=None, live_devices: Optional[int] = None):
         cfg = get_config()
         self.queue = queue if queue is not None else JobQueue()
-        self.pool = pool if pool is not None else EnginePool(mesh=mesh)
+        self.pool = pool if pool is not None else EnginePool(
+            mesh=mesh, live_devices=live_devices)
+        #: override of the live topology admission prices against
+        #: (default: the pool's view — mesh size, else the local device
+        #: count at admit time)
+        self.live_devices = live_devices
         self.block_width = int(block_width or cfg.serve_block_width)
         self.hbm_gb = float(hbm_gb)
         self.host_ram_gb = float(host_ram_gb)
@@ -97,14 +102,31 @@ class Scheduler:
         self._backlog_s = 0.0          # priced est_solve_s of queued work
         self._est_s: Dict[str, float] = {}
 
+    def live_device_count(self) -> int:
+        """The topology admission prices against (see :meth:`admit`)."""
+        if self.live_devices is not None:
+            return int(self.live_devices)
+        return self.pool.live_device_count()
+
     # -- admission ---------------------------------------------------------
 
     def admit(self, spec: JobSpec) -> dict:
         """Price one spec and return the admission verdict (also emitted
         as an ``admission`` event).  Does NOT enqueue — :meth:`submit`
-        composes the two."""
+        composes the two.
+
+        Pricing runs against the LIVE device count, not the spec's
+        original one: a job respooled from a service that ran at D
+        devices re-admits after a relaunch at D′ against the capacity
+        that actually exists (clamped mesh, re-priced apply/solve
+        estimates) — the elastic-fleet contract the serve leg of
+        ``make elastic-check`` gates."""
         cap = load_capacity_module()
-        price = cap.price_job(spec.pricing(), calibration=self.rates,
+        pricing = spec.pricing()
+        live = self.live_device_count()
+        asked = max(int(pricing.get("n_devices") or 1), 1)
+        pricing["n_devices"] = max(1, min(asked, live))
+        price = cap.price_job(pricing, calibration=self.rates,
                               hbm_gb=self.hbm_gb,
                               host_ram_gb=self.host_ram_gb)
         eta_s = round(self._backlog_s, 3)
@@ -122,6 +144,8 @@ class Scheduler:
         else:
             verdict, reason = "accept", ""
         out = {"verdict": verdict, "eta_s": eta_s, "reason": reason,
+               "live_devices": int(live),
+               "priced_devices": int(pricing["n_devices"]),
                **{k: price.get(k) for k in
                   ("est_apply_ms", "est_solve_s", "fits")}}
         with obs_trace.job_scope(spec.job_id):

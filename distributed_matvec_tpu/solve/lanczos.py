@@ -254,13 +254,15 @@ def _sharded_ckpt_engine(owner, shape) -> bool:
     """True when the matvec's owner is a distributed engine whose hashed
     [D, M(, 2)] vector layout matches ``shape`` — the case where a
     multi-process checkpoint can be written per shard (each rank saves its
-    addressable shards; no rank ever fetches the global Krylov basis)."""
-    return (owner is not None
-            and hasattr(owner, "_assemble_sharded")
-            and hasattr(owner, "counts")
+    addressable shards; no rank ever fetches the global Krylov basis).
+    The capability probe is ``reshard.hashed_ckpt_engine`` — the SAME
+    predicate that decides whether a save gets the topology stanza, so
+    layout detection and stanza writing can never disagree."""
+    from ..parallel.reshard import hashed_ckpt_engine
+    return (hashed_ckpt_engine(owner)
             and len(shape) >= 2
-            and shape[0] == getattr(owner, "n_devices", -1)
-            and shape[1] == getattr(owner, "shard_size", -1))
+            and shape[0] == owner.n_devices
+            and shape[1] == owner.shard_size)
 
 
 def _save_ckpt(path, fp, owner, V, meta, m, sharded) -> None:
@@ -269,7 +271,14 @@ def _save_ckpt(path, fp, owner, V, meta, m, sharded) -> None:
     writes its shards of every Krylov row plus the (replicated) recurrence
     metadata in ONE atomic per-rank file — metadata and rows can never be
     of mixed generations, and a crash mid-save leaves the previous
-    checkpoint intact."""
+    checkpoint intact.
+
+    Engine-backed saves add the v2 TOPOLOGY STANZA (D, shard size,
+    per-shard counts, partition fingerprint — ``parallel/reshard.py``) to
+    the metadata, so a restore on a different device count reshards the
+    snapshot instead of refusing it."""
+    from ..parallel.reshard import topology_stanza
+    meta = dict(meta, **topology_stanza(owner))
     if not sharded:
         from ..io.hdf5 import save_engine_structure
         save_engine_structure(path, fp, "lanczos",
@@ -319,43 +328,295 @@ def _soft_save_ckpt(path, fp, owner, V, meta, m, sharded,
     return True
 
 
-def _restore_ckpt(path, fp, owner, shape, sharded):
+def _partition_ok(meta, solver, path) -> bool:
+    """Refusal-with-pointer when the checkpoint's partition fingerprint
+    genuinely differs from this build's (a different shard hash): the
+    shard snapshots are NOT a permutation of the new partition, so a
+    reshard would scatter rows to wrong owners — refuse loudly, name both
+    fingerprints, and let the caller start fresh."""
+    from ..parallel.reshard import partition_fingerprint
+    want = partition_fingerprint()
+    got = str(meta.get("partition_fp", "") or "")
+    if not got or got == want:
+        return True
+    from ..utils.logging import log_warn
+    log_warn(
+        f"{solver} checkpoint at {path} was partitioned under {got}; this "
+        f"build partitions under {want} — the shard snapshots cannot be "
+        "resharded onto a different partition.  Starting fresh (delete "
+        "the checkpoint, or resume it on a build with the original "
+        "shard hash)")
+    obs_emit("solver_checkpoint", solver=solver, status="refused_partition",
+             path=str(path), checkpoint_partition=got,
+             build_partition=want)
+    return False
+
+
+def _reshard_degrade(solver, path, e) -> None:
+    """A torn/partial reshard (injected ``ckpt_reshard`` fault, missing
+    source shard, I/O failure) must degrade to a FRESH solve, never to a
+    half-redistributed basis — one warn + one event, then the caller
+    returns None."""
+    from ..utils.logging import log_warn
+    log_warn(f"{solver} checkpoint reshard failed ({e!r}); the restore "
+             "degrades to a fresh solve")
+    obs_emit("solver_checkpoint", solver=solver, status="reshard_failed",
+             path=str(path), error=repr(e))
+
+
+def _sharded_ckpt_meta(path, fp, legacy_fp):
+    """``(meta, fp_used)`` for a sharded checkpoint scan: the primary
+    topology-free fingerprint first, then the legacy fixed-D one —
+    shared by the Lanczos and LOBPCG restores so the probe order can
+    never diverge between the solvers."""
+    from ..io.sharded_io import load_hashed_meta
+    meta = load_hashed_meta(path, expected_fingerprint=fp)
+    if meta is not None or legacy_fp is None:
+        return meta, fp
+    return load_hashed_meta(path, expected_fingerprint=legacy_fp), legacy_fp
+
+
+def _needs_reshard(meta, owner) -> bool:
+    """Whether the checkpoint's topology stanza names a layout other
+    than the live engine's (stanza-free v1 metadata reads as matching —
+    fixed topology by construction)."""
+    src_d = int(meta.get("topology_d", owner.n_devices))
+    src_counts = np.asarray(meta.get("topology_counts", owner.counts),
+                            np.int64)
+    return (src_d != int(owner.n_devices)
+            or not np.array_equal(src_counts,
+                                  np.asarray(owner.counts, np.int64)))
+
+
+def _stage_reshard(path, fp, owner, meta, tail, n_rows, dtype):
+    """Collective-free half of a D→D′ restore: build the routing plan
+    and stage every source slice this rank's devices host.  Returns
+    ``(plan, staged, dt, err)`` — err instead of raising, so the caller
+    can fold the outcome into the fixed-point readiness agreement of
+    :func:`_restore_sharded_rows` before any collective dispatches."""
+    from ..io.sharded_io import hashed_shard_reader
+    from ..parallel import reshard as _rs
+
+    try:
+        plan = _rs.Resharder(owner, int(meta["topology_d"]),
+                             np.asarray(meta["topology_counts"], np.int64),
+                             tail=tail)
+        # scan-once reader: resolves the candidate .r* files one time
+        # (O(m·D) fetches would otherwise re-glob per slice, billed to
+        # resume_reshard_s) and rejects files whose own generation
+        # disagrees with the selected meta — barrier-free per-rank saves
+        # can leave mixed generations under one fingerprint, and the
+        # reshard path deliberately reads DEPARTED ranks' files
+        with hashed_shard_reader(path, expected_fingerprint=fp,
+                                 match_meta=meta) as fetch:
+            staged, dt = plan.stage_rows(
+                lambda i, s: fetch(s, name=f"krylov_{i}"),
+                n_rows, dtype=dtype)
+        return plan, staged, dt, None
+    except (_rs.PartitionMismatch, OSError, KeyError, ValueError) as e:
+        return None, None, None, e
+
+
+def _read_direct_rows(path, fp, owner, meta, n_rows, tail):
+    """Collective-free fixed-D read: this rank's shards of every
+    checkpointed row, assembled into ``[D, M, *tail]`` device rows.
+    ``(rows, err)`` — same err-returning contract as
+    :func:`_stage_reshard`."""
+    from ..io.sharded_io import hashed_shard_reader
+
+    M = owner.shard_size
+    rows_out = []
+    try:
+        # match_meta scopes every fetch to the generation load_hashed_meta
+        # selected — a stale same-fingerprint .r* file from before a thick
+        # restart must fail the restore (KeyError → fresh), not splice its
+        # old basis rows in
+        with hashed_shard_reader(path, expected_fingerprint=fp,
+                                 match_meta=meta) as fetch:
+            for i in range(n_rows):
+                pieces = [None] * owner.n_devices
+                for d in range(owner.n_devices):
+                    if not owner._shard_addressable(d):
+                        continue
+                    r = fetch(d, name=f"krylov_{i}")
+                    full = np.zeros((M,) + tail)
+                    full[: r.shape[0]] = r
+                    pieces[d] = full
+                rows_out.append(owner._assemble_sharded(pieces))
+        return rows_out, None
+    except (OSError, KeyError, ValueError) as e:
+        return None, e
+
+
+def _restore_sharded_rows(path, fp, legacy_fp, owner, shape, solver,
+                          dtype=None, expect_m=None):
+    """Sharded-format restore, safe on process-spanning meshes: select
+    the metadata (primary then legacy fingerprint), dispatch direct read
+    vs staged D→D′ reshard, agree, exchange.  Returns ``(meta, rows)``
+    with ``rows`` in the target ``[D, M, *tail]`` layout, or
+    ``(None, None)`` for a fresh start.
+
+    On a process-spanning engine every rank runs ONE fixed-shape
+    readiness allgather at this FIXED point, no matter which local
+    sub-path it took — metadata missing, partition refusal, torn
+    staging, incomplete direct read.  Scattering the agreement across
+    sub-paths would let ranks rendezvous on DIFFERENT collectives (one
+    rank's meta probe fails → it skips to the caller's generation
+    agreement while its peers sit in a staging vote) and hang the job.
+    The token carries (ok, reshard?, rows, total_iters, topology_d), so
+    ranks that prepared DIFFERENT restores — mixed generations, or one
+    resharding while another reads direct — all degrade to fresh
+    together; only a unanimous matching-token vote lets the exchange
+    dispatch its ppermute rounds.  Staging holds every one-sided
+    failure mode (file I/O, the injected ``ckpt_reshard`` fault); the
+    exchange after a unanimous vote is one identical static program on
+    every rank.
+
+    ``expect_m`` rejects a metadata generation whose basis size is not
+    the caller's (LOBPCG: the block width is fixed) before any staging.
+    """
+    import time as _time
+
+    meta, fp_used = _sharded_ckpt_meta(path, fp, legacy_fp)
+    if meta is not None and expect_m is not None \
+            and int(meta["m"]) != int(expect_m):
+        meta = None
+    if meta is not None and _needs_reshard(meta, owner) \
+            and not _partition_ok(meta, solver, path):
+        meta = None               # refusal-with-pointer: no restore
+    multi_span = bool(getattr(owner, "_multi", False))
+    if meta is None and not multi_span:
+        return None, None
+    tail = tuple(shape[2:])
+    reshard = meta is not None and _needs_reshard(meta, owner)
+    n_rows = int(meta["m"]) + 1 if meta is not None else 0
+    plan = staged = dt = rows = err = None
+    t0 = _time.perf_counter()
+    if reshard:
+        plan, staged, dt, err = _stage_reshard(path, fp_used, owner, meta,
+                                               tail, n_rows, dtype)
+    elif meta is not None:
+        rows, err = _read_direct_rows(path, fp_used, owner, meta, n_rows,
+                                      tail)
+    ok = meta is not None and err is None
+    if multi_span:
+        from jax.experimental import multihost_utils as _mhu
+        tok = np.array(
+            [int(ok), int(reshard), n_rows,
+             int(meta["total_iters"]) if meta is not None else -1,
+             int(meta.get("topology_d", owner.n_devices))
+             if meta is not None else -1], np.int64)
+        all_tok = _mhu.process_allgather(tok)
+        ok = bool((all_tok[:, 0] == 1).all()
+                  and (all_tok == all_tok[0]).all())
+    if not ok:
+        if err is not None and reshard:
+            _reshard_degrade(solver, path, err)
+        elif err is not None:
+            from ..utils.logging import log_debug
+            log_debug(f"{solver} sharded checkpoint incomplete ({err!r}); "
+                      "starting fresh")
+        elif multi_span and meta is not None:
+            from ..utils.logging import log_debug
+            log_debug(f"{solver} checkpoint restore readiness disagrees "
+                      "across ranks; starting fresh")
+        return None, None
+    if reshard:
+        rows = plan.exchange_rows(staged, dt)
+        obs_emit("solver_checkpoint", solver=solver, status="resharded",
+                 path=str(path), d_from=int(meta["topology_d"]),
+                 d_to=int(owner.n_devices), rows=int(n_rows),
+                 reshard_s=round(_time.perf_counter() - t0, 6))
+    return meta, rows
+
+
+def _global_rows_for_layout(got, owner, shape, solver, legacy_shape=None):
+    """Row list for a SINGLE-CONTROLLER checkpoint payload ``got`` in the
+    caller's vector layout ``shape``: direct when the stored topology
+    matches, resharded (``parallel/reshard.py``) on a D→D′ mismatch,
+    None (fresh start) when the rows fit neither.  ``legacy_shape``
+    additionally accepts pre-stanza rows of that shape verbatim (the
+    fixed-D v1 format — matching topology by construction)."""
+    import time as _time
+
+    V = got["V"]
+    src_d = got.get("topology_d")
+    if src_d is None or not hasattr(owner, "counts"):
+        # legacy fixed-D checkpoint (or a bare-callable solve): rows must
+        # already be in the caller's layout
+        for want in (tuple(shape),) + ((tuple(legacy_shape),)
+                                       if legacy_shape is not None else ()):
+            if tuple(V.shape[1:]) == want:
+                return [jnp.asarray(r) for r in V]
+        return None
+    src_d = int(src_d)
+    counts = np.asarray(got["topology_counts"], np.int64)
+    if not _needs_reshard(got, owner) and tuple(V.shape[1:]) == tuple(shape):
+        return [jnp.asarray(r) for r in V]
+    if not _partition_ok(got, solver, path="<engine_structure>"):
+        return None
+    t0 = _time.perf_counter()
+    try:
+        from ..parallel import reshard as _rs
+        plan = _rs.Resharder(owner, src_d, counts, tail=tuple(shape[2:]))
+        rows = plan.reshard_rows(
+            lambda i, s: V[i, s, : counts[s]], V.shape[0], dtype=V.dtype)
+    except (OSError, KeyError, ValueError) as e:      # PartitionMismatch
+        _reshard_degrade(solver, "<engine_structure>", e)   # ⊂ ValueError
+        return None
+    obs_emit("solver_checkpoint", solver=solver, status="resharded",
+             d_from=src_d, d_to=int(owner.n_devices), rows=int(V.shape[0]),
+             reshard_s=round(_time.perf_counter() - t0, 6))
+    return rows
+
+
+def _restore_ckpt(path, fp, owner, shape, sharded, legacy_fp=None,
+                  solver="lanczos", legacy_shape=None, dtype=None):
     """Inverse of :func:`_save_ckpt`; returns a dict with ``V_rows`` (list
     of per-row arrays in the vector layout) plus the recurrence metadata,
-    or None when no matching checkpoint exists."""
+    or None when no matching checkpoint exists.
+
+    ``legacy_fp`` additionally probes the pre-elastic shape-keyed
+    fingerprint, so fixed-D v1 checkpoints still restore unchanged on a
+    matching device count; ``legacy_shape`` is the per-row shape that
+    format stored when it differs from ``shape`` (the distributed LOBPCG
+    v1 format kept FLAT padded columns where v2 keeps hashed rows).
+    ``dtype`` pins the row dtype for a sharded reshard (a rank whose
+    devices host no source shard must still build dtype-consistent
+    slabs).  A checkpoint whose topology stanza names a DIFFERENT device
+    count is resharded onto the live topology (``parallel/reshard.py``)
+    instead of refused; a reshard that cannot proceed (foreign partition
+    fingerprint, torn source files, the injected ``ckpt_reshard`` fault)
+    degrades to a fresh solve with one warn + ``solver_checkpoint``
+    event.  A single-controller restore (``sharded=False``) whose
+    base-path probe misses falls through to the sharded-format scan, so
+    per-rank ``.r*`` files written by a larger multi-process incarnation
+    still resume after an elastic shrink to one process."""
     if not sharded:
         from ..io.hdf5 import load_engine_structure
         got = load_engine_structure(path, fp)
-        if got is None:
+        legacy = None
+        if got is None and legacy_fp is not None:
+            got = load_engine_structure(path, legacy_fp)
+            legacy = legacy_shape if legacy_shape is not None else shape
+        if got is not None:
+            rows = _global_rows_for_layout(got, owner, shape, solver,
+                                           legacy_shape=legacy)
+            if rows is None:
+                return None
+            return dict(got, V_rows=rows)
+        # The single-controller probe missed, but a LARGER multi-process
+        # incarnation of this job may have left per-rank .r* files on
+        # shared storage — an elastic shrink to ONE process must not
+        # orphan them.  Fall through to the sharded-format scan when the
+        # owner can consume the hashed layout: the reshard machinery
+        # already reads departed ranks' files, the single-controller
+        # restore just has to probe the format.
+        if not _sharded_ckpt_engine(owner, shape):
             return None
-        return dict(got, V_rows=[jnp.asarray(r) for r in got["V"]])
-    from ..io.sharded_io import load_hashed_meta, load_hashed_shard
-
-    # fingerprint-filtered scan: a stale base-path file from an earlier
-    # single-process run must not mask valid per-rank .r* checkpoints
-    meta = load_hashed_meta(path, expected_fingerprint=fp)
+    meta, rows_out = _restore_sharded_rows(path, fp, legacy_fp, owner,
+                                           shape, solver, dtype=dtype)
     if meta is None:
-        return None
-    m = int(meta["m"])
-    D, M = owner.n_devices, owner.shard_size
-    tail = shape[2:]
-    rows_out = []
-    try:
-        for i in range(m + 1):
-            pieces = [None] * D
-            for d in range(D):
-                if not owner._shard_addressable(d):
-                    continue
-                r = load_hashed_shard(path, d, name=f"krylov_{i}",
-                                      expected_fingerprint=fp)
-                full = np.zeros((M,) + tuple(tail))
-                full[: r.shape[0]] = r
-                pieces[d] = full
-            rows_out.append(owner._assemble_sharded(pieces))
-    except KeyError:
-        from ..utils.logging import log_debug
-        log_debug("lanczos sharded checkpoint incomplete (row data missing "
-                  "for this rank's shards); starting fresh")
         return None
     return dict(meta, V_rows=rows_out)
 
@@ -1190,15 +1451,31 @@ def _lanczos_impl(
     # foreign Krylov state instead of silently restoring it.  Bare
     # callables fall back to shape-only keying (documented caller
     # responsibility).
-    ckpt_fp = f"{tuple(shape)}|{np.dtype(dtype).str}|{_operator_key(owner)}" \
-        "|lanczos-v2"
+    #
+    # Engine-backed hashed solves key TOPOLOGY-FREE (lanczos-v3): the
+    # (D, M) layout dims are deliberately out of the fingerprint — the
+    # operator key + row tail identify the vector SPACE — so a checkpoint
+    # written at D devices is FOUND at D′ and resharded on restore
+    # (parallel/reshard.py).  The legacy shape-keyed v2 fingerprint is
+    # still probed on restore, so pre-elastic fixed-D checkpoints resume
+    # unchanged on a matching device count.
+    hashed_layout = _sharded_ckpt_engine(owner, shape)
+    if hashed_layout:
+        ckpt_fp = (f"hashed{tuple(shape[2:])}|{np.dtype(dtype).str}"
+                   f"|{_operator_key(owner)}|lanczos-v3")
+        legacy_fp = (f"{tuple(shape)}|{np.dtype(dtype).str}"
+                     f"|{_operator_key(owner)}|lanczos-v2")
+    else:
+        ckpt_fp = (f"{tuple(shape)}|{np.dtype(dtype).str}"
+                   f"|{_operator_key(owner)}|lanczos-v2")
+        legacy_fp = None
     resumed_from = 0
     multi = jax.process_count() > 1
     # Multi-process checkpointing needs a per-shard vector format (no rank
     # can fetch the global Krylov basis): available for engine-backed
     # matvecs over hashed [D, M(, 2)] vectors; bare callables stay
     # single-controller-only.
-    sharded_ckpt = multi and _sharded_ckpt_engine(owner, shape)
+    sharded_ckpt = multi and hashed_layout
     if checkpoint_path and multi and not sharded_ckpt:
         from ..utils.logging import log_debug
         log_debug("lanczos checkpointing disabled: multi-process run with "
@@ -1206,16 +1483,30 @@ def _lanczos_impl(
         checkpoint_path = None
     if checkpoint_path:
         got = _restore_ckpt(checkpoint_path, ckpt_fp, owner, shape,
-                            sharded=sharded_ckpt)
-        if sharded_ckpt:
+                            sharded=sharded_ckpt, legacy_fp=legacy_fp,
+                            dtype=np.dtype(dtype))
+        if sharded_ckpt and (owner is None
+                             or bool(getattr(owner, "_multi", True))):
             # Per-rank checkpoint files are written without a barrier, so
             # ranks can observe different generations (or one none at all).
             # Resuming from mixed states would desynchronize the SPMD
             # collective programs — agree on (m, total_iters) and start
             # fresh everywhere unless every rank restored the same state.
+            # Rank-local-mesh engines (_multi False) skip the agreement:
+            # their solves are process-local.  For a TRUE process-spanning
+            # engine a FAILED agreement collective propagates and kills
+            # the rank — deliberately NOT the local-fallback arm
+            # agree_restored uses for plan caches.  There a rebuild is
+            # bit-identical to a restore, so a locally-kept verdict is
+            # harmless; here fresh and resumed solver states genuinely
+            # differ, and a rank deciding "fresh" locally while a peer's
+            # allgather succeeded (it contributed our token before we
+            # raised) would desynchronize the very SPMD programs this
+            # agreement exists to protect.  Any backend that can run a
+            # process-spanning engine can run this collective.
             from jax.experimental import multihost_utils as _mhu
-            tok = np.array([got["m"], got["total_iters"]] if got is not None
-                           else [-1, -1], np.int64)
+            tok = np.array([got["m"], got["total_iters"]]
+                           if got is not None else [-1, -1], np.int64)
             all_tok = _mhu.process_allgather(tok)
             if not (all_tok >= 0).all() or \
                     not (all_tok == all_tok[0]).all():

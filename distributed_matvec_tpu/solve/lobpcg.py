@@ -194,7 +194,19 @@ def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         """Checkpoint identity: vector space + block width + operator —
         the same keying contract as the Lanczos checkpoints (a rerun
         against an edited Hamiltonian of the same size misses instead of
-        restoring a foreign block)."""
+        restoring a foreign block).  Distributed-engine solves key
+        TOPOLOGY-FREE (v2: n_states, not the flat padded dim, which bakes
+        in D·M), so a block snapshot written at D devices is found at D′
+        and resharded on restore — the lanczos-v3 contract."""
+        if dist:
+            return (f"lobpcg|nst{int(owner.n_states)}|{cols}"
+                    f"|{int(bool(pair))}|{_operator_key(owner)}|v2")
+        return f"lobpcg|{dim_}|{cols}|{int(bool(pair))}" \
+               f"|{_operator_key(owner)}|v1"
+
+    def _ckpt_fp_legacy(dim_, cols):
+        """The pre-elastic fixed-topology fingerprint, still probed on
+        restore so v1 checkpoints resume unchanged on a matching D."""
         return f"lobpcg|{dim_}|{cols}|{int(bool(pair))}" \
                f"|{_operator_key(owner)}|v1"
 
@@ -216,12 +228,30 @@ def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
         X = jnp.asarray(U0q)
         cols = int(X.shape[1])
         done = 0
+        # distributed engines snapshot the block as HASHED rows
+        # [cols, D, M(, 2)] — the topology-portable layout the stanza
+        # describes, resharded on a D→D′ restore; local solves keep the
+        # flat [cols, n] rows (fixed layout by construction)
+        hashed_tail = ((2,) if pair else ()) if dist else ()
+        row_shape = ((owner.n_devices, owner.shard_size) + hashed_tail) \
+            if dist else (dim_,)
         if checkpoint_path:
             fp = _ckpt_fp(dim_, cols)
-            got = _restore_ckpt(checkpoint_path, fp, None, X.shape,
-                                sharded=False)
+            got = _restore_ckpt(
+                checkpoint_path, fp, owner if dist else None, row_shape,
+                sharded=False, solver="lobpcg",
+                legacy_fp=_ckpt_fp_legacy(dim_, cols) if dist else None,
+                # the v1 distributed format stored FLAT padded columns
+                legacy_shape=(dim_,) if dist else None)
             if got is not None and len(got["V_rows"]) == cols:
-                X = jnp.stack(got["V_rows"], axis=1).astype(X.dtype)
+                rows = got["V_rows"]
+                if dist and rows[0].ndim >= 2:
+                    # hashed rows → flat columns (stack cols on axis 2:
+                    # [D, M, cols(, 2)], exactly to_flat's input layout)
+                    X = jax.jit(to_flat)(
+                        jnp.stack(rows, axis=2)).astype(X.dtype)
+                else:
+                    X = jnp.stack(rows, axis=1).astype(X.dtype)
                 done = int(got["total_iters"])
                 obs_emit("solver_resume", solver="lobpcg",
                          iters=int(done), path=checkpoint_path)
@@ -245,8 +275,10 @@ def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             X = U
             if not checkpoint_path:
                 break
-            _soft_save_ckpt(checkpoint_path, fp, None,
-                            jnp.swapaxes(U, 0, 1),
+            V_save = jnp.moveaxis(from_flat(U), 2, 0) if dist \
+                else jnp.swapaxes(U, 0, 1)
+            _soft_save_ckpt(checkpoint_path, fp, owner if dist else None,
+                            V_save,
                             {"m": cols - 1, "total_iters": int(done)},
                             cols - 1, sharded=False, solver="lobpcg")
             # lobpcg_standard breaks early on convergence, so a full
@@ -382,7 +414,7 @@ def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             # collectives, same gating as lanczos's agree_multi
             agree = bool(getattr(owner, "_multi", True))
             if checkpoint_path:
-                got = _restore_block_multi(fp, cols, agree)
+                got = _restore_block_multi(fp, cols)
                 if got is not None:
                     X, done = got
                     obs_emit("solver_resume", solver="lobpcg",
@@ -424,47 +456,31 @@ def _lobpcg_impl(matvec: Callable, n: int, k: int = 1, max_iters: int = 200,
             order = np.argsort(evals)
             return sigma, evals[order], U[:, jnp.asarray(order)], int(done)
 
-        def _restore_block_multi(fp, cols, agree):
-            """Per-shard block restore + the cross-rank generation
-            agreement (per-rank snapshot files are written without a
-            barrier; resuming from mixed generations would desynchronize
-            the SPMD programs — all ranks agree or all start fresh;
-            ``agree=False`` = rank-local mesh, local verdict only)."""
-            from ..io.sharded_io import load_hashed_meta, load_hashed_shard
+        def _restore_block_multi(fp, cols):
+            """Per-shard block restore via the solver-shared
+            :func:`lanczos._restore_sharded_rows`: fingerprint probe
+            (primary then legacy, so v1 checkpoints restore unchanged on
+            a matching D), D→D′ reshard on a topology-stanza mismatch
+            (the lanczos contract, ``parallel/reshard.py``), and the
+            fixed-point cross-rank readiness agreement — per-rank
+            snapshot files are written without a barrier, so all ranks
+            restore the same generation or all start fresh (rank-local
+            meshes keep a local verdict).  ``expect_m`` pins the block
+            width: a snapshot of a different ``cols`` is not this
+            solve's."""
+            from .lanczos import _restore_sharded_rows
 
-            meta = load_hashed_meta(checkpoint_path,
-                                    expected_fingerprint=fp)
-            got = None
-            if meta is not None and int(meta["m"]) == cols - 1:
-                D_, M_ = owner.n_devices, owner.shard_size
-                tail = (2,) if pair else ()
-                pieces = [None] * D_
-                try:
-                    for d in range(D_):
-                        if not owner._shard_addressable(d):
-                            continue
-                        buf = np.zeros((M_, cols) + tail)
-                        for i in range(cols):
-                            r = load_hashed_shard(
-                                checkpoint_path, d, name=f"krylov_{i}",
-                                expected_fingerprint=fp)
-                            buf[: r.shape[0], i] = r
-                        pieces[d] = buf
-                    got = (owner._assemble_sharded(pieces),
-                           int(meta["total_iters"]))
-                except KeyError:
-                    got = None
-            if agree:
-                from jax.experimental import multihost_utils as _mhu
-                tok = np.array([got[1] if got is not None else -1],
-                               np.int64)
-                all_tok = _mhu.process_allgather(tok)
-                if not (all_tok >= 0).all() \
-                        or not (all_tok == all_tok[0]).all():
-                    return None
-            if got is None:
+            tail = (2,) if pair else ()
+            meta, rows = _restore_sharded_rows(
+                checkpoint_path, fp, _ckpt_fp_legacy(dim, cols), owner,
+                (owner.n_devices, owner.shard_size) + tail, "lobpcg",
+                dtype=np.float64, expect_m=cols - 1)
+            if meta is None:
                 return None
-            return jax.jit(to_flat)(got[0]), got[1]
+            # per-column hashed rows → the [D, M, cols(, 2)] block
+            # layout the flat adapters consume
+            Xh = jax.jit(lambda *rs: jnp.stack(rs, axis=2))(*rows)
+            return jax.jit(to_flat)(Xh), int(meta["total_iters"])
 
     if not pair:
         if dist:

@@ -6,7 +6,10 @@ code until something actually fails, and real failures on a preemptible
 TPU slice are neither deterministic nor cheap to reproduce.  This module
 turns them into a knob: named *sites* sit on every I/O and comms edge
 (artifact load/save, streamed plan-chunk reads, checkpoint write/rename,
-H2D plan upload, the exchange dispatch, the solver block boundary), and
+the D→D′ checkpoint reshard (``ckpt_reshard``, parallel/reshard.py — a
+torn redistribution must degrade to a fresh solve, never resume a
+half-resharded basis), H2D plan upload, the exchange dispatch, the
+solver block boundary), and
 
     DMT_FAULT="site[:field=value]*[,site2...]"
 

@@ -126,6 +126,40 @@ if os.environ.get("DMT_MH_PIPE") is not None:
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_ELASTIC"):
+    # Elastic leg (tests/test_elastic.py): topology-portable checkpoints
+    # across a REAL 2-process job.  Each rank solves on a RANK-LOCAL
+    # 4-device mesh (the CPU backend cannot run cross-process
+    # computations — same constraint as every fast leg here) with
+    # sharded per-rank checkpointing: the truncated first solve writes
+    # `elastic_ck.h5.r<rank>` files at D=4, then the SAME solve resumes
+    # on a 2-device rank-local mesh — the restore finds the old-topology
+    # .r* files, reshards 4→2 (parallel/reshard.py), carries the
+    # iteration count, and lands the exact ring ground state.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+
+    scratch = os.environ["DMT_MH_ELASTIC"]
+    ck = os.path.join(scratch, "elastic_ck.h5")
+    eng4 = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                             mode="ell")
+    part = lanczos(eng4.matvec, v0=eng4.random_hashed(seed=5), k=1,
+                   tol=1e-12, max_iters=12, check_every=4,
+                   checkpoint_path=ck, checkpoint_every=1)
+    assert not part.converged
+    eng2 = DistributedEngine(op,
+                             mesh=make_mesh(devices=jax.local_devices()[:2]),
+                             mode="ell")
+    res = lanczos(eng2.matvec, v0=eng2.random_hashed(seed=5), k=1,
+                  tol=1e-9, max_iters=400, check_every=8,
+                  checkpoint_path=ck)
+    assert res.resumed_from == 12, res.resumed_from
+    e0 = float(res.eigenvalues[0])
+    print(f"[p{pid}] elastic resumed E0/4 = {e0 / 4:.10f}", flush=True)
+    assert abs(e0 / 4 - E0_OVER_4) < 1e-7, e0
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_SERVE"):
     # Solve-service leg (tests/test_serve.py): two SAME-BASIS jobs
     # submitted to a scheduler whose engine pool runs over a RANK-LOCAL

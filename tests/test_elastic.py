@@ -1,0 +1,537 @@
+"""Elastic solves: topology-portable checkpoints, D→D′ resharded resume.
+
+Pins the contracts of ``parallel/reshard.py`` + the solver restore paths
+(DESIGN.md §27):
+
+* the checkpoint topology stanza round-trips (D, shard size, counts,
+  partition fingerprint);
+* the reshard redistribution is EXACTLY the permutation the target
+  layout defines — bit-identical to ``to_hashed`` at D′ for every
+  (D, D′) ∈ {1, 2, 4}², pair tails included;
+* Lanczos resumes a D-written checkpoint at D′ in both directions with
+  the iteration count carried over and E0 unchanged; LOBPCG does the
+  same and agrees with Lanczos;
+* a checkpoint written under a FOREIGN partition (different shard hash)
+  is refused with a pointer, and an injected torn reshard
+  (``DMT_FAULT=ckpt_reshard``) degrades to a fresh solve — never a
+  half-redistributed basis;
+* legacy fixed-D (v1) checkpoints still restore unchanged on matching D;
+* the serve layer re-admits against LIVE capacity and prunes warm
+  engines whose mesh no longer fits; the heartbeat watchdog scopes its
+  scan to the current rank set and ages out departed ranks' beat files;
+* a REAL 2-process run (multihost worker, elastic leg) reshards
+  per-rank ``.r*`` checkpoint files written at the old topology.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu import obs
+from distributed_matvec_tpu.models.basis import SpinBasis
+from distributed_matvec_tpu.models.lattices import (chain_edges,
+                                                    heisenberg_from_edges)
+from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+from distributed_matvec_tpu.parallel.reshard import (PartitionMismatch,
+                                                     Resharder,
+                                                     partition_fingerprint,
+                                                     topology_stanza)
+from distributed_matvec_tpu.solve import lanczos, lobpcg
+from distributed_matvec_tpu.utils import faults
+
+
+def make_op(n=10):
+    basis = SpinBasis(number_spins=n, hamming_weight=n // 2)
+    return heisenberg_from_edges(basis, chain_edges(n))
+
+
+def _reshard_events(solver=None, status="resharded"):
+    return [e for e in obs.events("solver_checkpoint")
+            if e.get("status") == status
+            and (solver is None or e.get("solver") == solver)]
+
+
+# ---------------------------------------------------------------------------
+# stanza + permutation core
+
+
+def test_partition_fingerprint_and_stanza():
+    fp = partition_fingerprint()
+    assert fp.startswith("splitmix64:") and fp == partition_fingerprint()
+    eng = DistributedEngine(make_op(), n_devices=2, mode="fused")
+    st = topology_stanza(eng)
+    assert st["ckpt_version"] == 2
+    assert st["topology_d"] == 2
+    assert st["topology_m"] == eng.shard_size
+    assert np.array_equal(st["topology_counts"], eng.counts)
+    assert st["partition_fp"] == fp
+    # non-hashed owners carry no stanza (fixed-topology by construction)
+    assert topology_stanza(None) == {}
+
+
+@pytest.mark.parametrize("d_src", [1, 2, 4])
+@pytest.mark.parametrize("d_dst", [1, 2, 4])
+def test_reshard_is_the_layout_permutation(d_src, d_dst, rng):
+    """Redistributed rows are BIT-IDENTICAL to hashing the same global
+    vector directly at D′ — reshard is a permutation, not arithmetic."""
+    op = make_op()
+    src = DistributedEngine(op, n_devices=d_src, mode="fused")
+    dst = DistributedEngine(make_op(), n_devices=d_dst, mode="fused")
+    x = rng.standard_normal(op.basis.number_states)
+    xh_src = np.asarray(src.to_hashed(x))
+    plan = Resharder(dst, d_src, src.counts)
+    rows = plan.reshard_rows(
+        lambda i, s: xh_src[s][: int(src.counts[s])], 1, dtype=np.float64)
+    assert np.array_equal(np.asarray(rows[0]), np.asarray(dst.to_hashed(x)))
+
+
+def test_reshard_pair_tail(rng):
+    """Trailing (re, im) pair axes ride the same permutation."""
+    op = make_op()
+    src = DistributedEngine(op, n_devices=4, mode="fused")
+    dst = DistributedEngine(make_op(), n_devices=2, mode="fused")
+    xt = rng.standard_normal((op.basis.number_states, 2))
+    xh = np.asarray(src.to_hashed(xt))
+    plan = Resharder(dst, 4, src.counts, tail=(2,))
+    rows = plan.reshard_rows(lambda i, s: xh[s][: int(src.counts[s])], 1)
+    assert np.array_equal(np.asarray(rows[0]), np.asarray(dst.to_hashed(xt)))
+
+
+def test_reshard_refuses_foreign_partition():
+    """Counts that disagree with the recomputed partition = a different
+    shard hash: refusal names the mismatch instead of scattering rows."""
+    dst = DistributedEngine(make_op(), n_devices=2, mode="fused")
+    src = DistributedEngine(make_op(), n_devices=4, mode="fused")
+    with pytest.raises(PartitionMismatch, match="different shard hash"):
+        Resharder(dst, 4, np.asarray(src.counts) + 1)
+
+
+# ---------------------------------------------------------------------------
+# lanczos: resharded resume
+
+
+def _ckpt_solve(eng, ck, **kw):
+    return lanczos(eng.matvec, v0=eng.random_hashed(seed=3), k=1,
+                   tol=1e-12, checkpoint_path=str(ck), **kw)
+
+
+def test_lanczos_resume_resharded_both_directions(tmp_path):
+    op = make_op(12)
+    eng2 = DistributedEngine(op, n_devices=2, mode="ell")
+    ref = lanczos(eng2.matvec, v0=eng2.random_hashed(seed=3), k=1,
+                  tol=1e-12, max_iters=400)
+    e0 = float(ref.eigenvalues[0])
+
+    ck = tmp_path / "ck.h5"
+    part = _ckpt_solve(eng2, ck, max_iters=24, check_every=8,
+                       checkpoint_every=1)
+    assert not part.converged
+
+    # grow 2 → 4: resumed iterations carried over, E0 bit-for-bit class
+    eng4 = DistributedEngine(make_op(12), n_devices=4, mode="ell")
+    res4 = _ckpt_solve(eng4, ck, max_iters=400)
+    assert res4.resumed_from == 24
+    assert abs(float(res4.eigenvalues[0]) - e0) <= 1e-12 * abs(e0)
+    ev = _reshard_events("lanczos")[-1]
+    assert (ev["d_from"], ev["d_to"]) == (2, 4) and ev["reshard_s"] > 0
+
+    # shrink 4 → 1 from the checkpoint the D=4 run kept writing
+    eng1 = DistributedEngine(make_op(12), n_devices=1, mode="ell")
+    res1 = _ckpt_solve(eng1, ck, max_iters=400)
+    assert res1.resumed_from > 0
+    assert abs(float(res1.eigenvalues[0]) - e0) <= 1e-12 * abs(e0)
+    ev = _reshard_events("lanczos")[-1]
+    assert ev["d_to"] == 1
+
+
+def test_topology_stanza_roundtrip_in_checkpoint(tmp_path):
+    """The stanza written with a single-controller engine checkpoint is
+    readable next to the rows it describes."""
+    import h5py
+
+    eng = DistributedEngine(make_op(), n_devices=2, mode="ell")
+    ck = tmp_path / "ck.h5"
+    _ckpt_solve(eng, ck, max_iters=8, check_every=4, checkpoint_every=1)
+    with h5py.File(str(ck), "r") as f:
+        g = f["engine_structure"]
+        assert int(g.attrs["topology_d"]) == 2
+        assert int(g.attrs["ckpt_version"]) == 2
+        assert str(g.attrs["partition_fp"]) == partition_fingerprint()
+        assert np.array_equal(g["topology_counts"][...], eng.counts)
+
+
+def test_partition_fp_mismatch_refused_with_pointer(tmp_path):
+    """A checkpoint stamped with a FOREIGN partition fingerprint (a
+    different hash seed) is refused — fresh solve, event naming both
+    fingerprints — instead of being resharded into garbage."""
+    import h5py
+
+    eng2 = DistributedEngine(make_op(), n_devices=2, mode="ell")
+    ck = tmp_path / "ck.h5"
+    _ckpt_solve(eng2, ck, max_iters=8, check_every=4, checkpoint_every=1)
+    with h5py.File(str(ck), "r+") as f:
+        f["engine_structure"].attrs["partition_fp"] = "splitmix64:deadbeef"
+    eng4 = DistributedEngine(make_op(), n_devices=4, mode="ell")
+    res = _ckpt_solve(eng4, ck, max_iters=200)
+    assert res.resumed_from == 0 and res.converged
+    evs = _reshard_events(status="refused_partition")
+    assert evs, "no refusal event"
+    assert evs[-1]["checkpoint_partition"] == "splitmix64:deadbeef"
+    assert evs[-1]["build_partition"] == partition_fingerprint()
+
+
+def test_legacy_v1_checkpoint_restores_on_matching_d(tmp_path):
+    """A pre-elastic checkpoint (shape-keyed fingerprint, no topology
+    stanza) still restores unchanged on the SAME device count."""
+    import h5py
+
+    eng = DistributedEngine(make_op(), n_devices=2, mode="ell")
+    ck = tmp_path / "ck.h5"
+    part = _ckpt_solve(eng, ck, max_iters=16, check_every=8,
+                       checkpoint_every=1)
+    assert not part.converged
+    # rewrite the file into the v1 format: legacy fingerprint, no stanza
+    shape = (eng.n_devices, eng.shard_size)
+    from distributed_matvec_tpu.solve.lanczos import _operator_key
+    legacy_fp = (f"{shape}|{np.dtype(np.float64).str}"
+                 f"|{_operator_key(eng)}|lanczos-v2")
+    with h5py.File(str(ck), "r+") as f:
+        g = f["engine_structure"]
+        g.attrs["fingerprint"] = legacy_fp
+        for k in ("topology_d", "topology_m", "partition_fp",
+                  "ckpt_version"):
+            del g.attrs[k]
+        del g["topology_counts"]
+    n_ev = len(_reshard_events())
+    res = _ckpt_solve(eng, ck, max_iters=400)
+    assert res.resumed_from == 16
+    assert len(_reshard_events()) == n_ev, \
+        "matching-D legacy restore must not reshard"
+
+
+def test_ckpt_reshard_fault_degrades_to_fresh(tmp_path):
+    """The injected ``ckpt_reshard`` fault (registry contract: one
+    ``[fault-injection]``-prefixed OSError) makes the D→D′ restore
+    degrade to a fresh — still converged — solve."""
+    eng2 = DistributedEngine(make_op(), n_devices=2, mode="ell")
+    ck = tmp_path / "ck.h5"
+    _ckpt_solve(eng2, ck, max_iters=16, check_every=8, checkpoint_every=1)
+    eng4 = DistributedEngine(make_op(), n_devices=4, mode="ell")
+    os.environ["DMT_FAULT"] = "ckpt_reshard:n=1"
+    faults.reset()
+    try:
+        res = _ckpt_solve(eng4, ck, max_iters=300)
+    finally:
+        os.environ.pop("DMT_FAULT", None)
+        faults.reset()
+    assert res.resumed_from == 0 and res.converged
+    assert faults.fired_count("ckpt_reshard") == 0  # reset above
+    evs = _reshard_events(status="reshard_failed")
+    assert evs and "[fault-injection]" in evs[-1]["error"]
+
+
+def test_shard_reader_rejects_mixed_generations(tmp_path):
+    """Barrier-free per-rank saves can leave same-fingerprint ``.r*``
+    files of DIFFERENT generations (a SIGKILL between rank saves right
+    after a thick restart, which SHRINKS ``m``); restore fetches must
+    stay inside the generation the selected metadata names — a stale
+    file satisfying a fetch would splice old basis rows into the
+    resume."""
+    from distributed_matvec_tpu.io.sharded_io import (hashed_shard_reader,
+                                                      save_hashed_vectors)
+
+    base = str(tmp_path / "ck.h5")
+    fresh = np.arange(4.0)
+    stale = -np.arange(4.0)
+    save_hashed_vectors(f"{base}.r0", {"krylov_0": fresh[None]},
+                        counts=[4],
+                        meta={"fingerprint": "fp", "m": 2,
+                              "total_iters": 12})
+    save_hashed_vectors(f"{base}.r1", {"krylov_0": stale[None],
+                                       "krylov_7": stale[None]},
+                        counts=[4],
+                        meta={"fingerprint": "fp", "m": 5,
+                              "total_iters": 40})
+    sel = {"m": 2, "total_iters": 12}
+    with hashed_shard_reader(base, expected_fingerprint="fp",
+                             match_meta=sel) as fetch:
+        assert np.array_equal(fetch(0, name="krylov_0"), fresh)
+        with pytest.raises(KeyError):   # only the STALE generation has it
+            fetch(0, name="krylov_7")
+    # the same fetch without the generation filter proves the stale file
+    # would otherwise have answered
+    with hashed_shard_reader(base, expected_fingerprint="fp") as fetch:
+        assert np.array_equal(fetch(0, name="krylov_7"), stale)
+
+
+def test_single_process_resume_of_multiproc_rank_files(tmp_path):
+    """A multi-process incarnation left per-rank ``.r*`` checkpoint
+    files on shared storage and the fleet shrank to ONE process: the
+    single-controller restore must fall through to the sharded-format
+    scan (and reshard D→D′) instead of silently starting the multi-hour
+    solve fresh."""
+    import h5py
+
+    from distributed_matvec_tpu.io.sharded_io import save_hashed_vectors
+
+    op = make_op(12)
+    eng2 = DistributedEngine(op, n_devices=2, mode="ell")
+    ref = lanczos(eng2.matvec, v0=eng2.random_hashed(seed=3), k=1,
+                  tol=1e-12, max_iters=400)
+    e0 = float(ref.eigenvalues[0])
+    ck = tmp_path / "ck.h5"
+    part = _ckpt_solve(eng2, ck, max_iters=24, check_every=8,
+                       checkpoint_every=1)
+    assert not part.converged
+    # convert the checkpoint into the per-rank sharded-format files a
+    # 2-process run would have written (rank r holds shard r only)
+    with h5py.File(str(ck), "r") as f:
+        g = f["engine_structure"]
+        V = g["V"][...]
+        meta = {k: g.attrs[k] for k in g.attrs}
+        for k in g:
+            if k != "V":
+                meta[k] = g[k][...]
+    counts = np.asarray(eng2.counts, np.int64)
+    for rank in (0, 1):
+        rows = {f"krylov_{i}": {rank: V[i, rank, : counts[rank]]}
+                for i in range(V.shape[0])}
+        save_hashed_vectors(f"{ck}.r{rank}", rows, counts, meta=meta)
+    os.remove(str(ck))
+
+    eng4 = DistributedEngine(make_op(12), n_devices=4, mode="ell")
+    res = _ckpt_solve(eng4, ck, max_iters=400)
+    assert res.resumed_from == 24
+    assert abs(float(res.eigenvalues[0]) - e0) <= 1e-12 * abs(e0)
+    ev = _reshard_events("lanczos")[-1]
+    assert (ev["d_from"], ev["d_to"]) == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# lobpcg twin
+
+
+def test_lobpcg_resume_resharded_parity_with_lanczos(tmp_path):
+    op = make_op(12)
+    eng2 = DistributedEngine(op, n_devices=2, mode="ell")
+    lref = lanczos(eng2.matvec, v0=eng2.random_hashed(seed=3), k=1,
+                   tol=1e-12, max_iters=400)
+    e0 = float(lref.eigenvalues[0])
+
+    ck = tmp_path / "ck_lob.h5"
+    evals_p, _, it_p = lobpcg(eng2.matvec, eng2.n_states, k=1, tol=1e-9,
+                              max_iters=20, checkpoint_path=str(ck),
+                              checkpoint_every=10)
+    eng4 = DistributedEngine(make_op(12), n_devices=4, mode="ell")
+    evals_r, _, it_r = lobpcg(eng4.matvec, eng4.n_states, k=1, tol=1e-9,
+                              max_iters=300, checkpoint_path=str(ck),
+                              checkpoint_every=50)
+    resumes = [e for e in obs.events("solver_resume")
+               if e.get("solver") == "lobpcg"]
+    assert resumes and resumes[-1]["iters"] == it_p
+    assert _reshard_events("lobpcg"), "lobpcg restore never resharded"
+    # parity with the Lanczos answer at the solver's own tolerance
+    assert abs(evals_r[0] - e0) <= 1e-7 * abs(e0)
+
+
+def test_lobpcg_legacy_v1_flat_checkpoint_restores(tmp_path):
+    """A pre-elastic distributed LOBPCG checkpoint stored FLAT padded
+    columns under the v1 fingerprint — it must still warm-start on the
+    same device count (the v1 compat contract, LOBPCG flavor)."""
+    import h5py
+
+    eng = DistributedEngine(make_op(), n_devices=2, mode="ell")
+    ck = tmp_path / "ck_lob_v1.h5"
+    _, _, it_p = lobpcg(eng.matvec, eng.n_states, k=1, tol=1e-9,
+                        max_iters=20, checkpoint_path=str(ck),
+                        checkpoint_every=10)
+    # rewrite the v2 file into the v1 format: legacy fingerprint, no
+    # stanza, rows FLATTENED to the padded [dim] columns v1 stored
+    from distributed_matvec_tpu.solve.lanczos import _operator_key
+    dim = eng.n_devices * eng.shard_size
+    with h5py.File(str(ck), "r+") as f:
+        g = f["engine_structure"]
+        cols = g["V"].shape[0]
+        V_flat = g["V"][...].reshape(cols, dim)
+        del g["V"]
+        g.create_dataset("V", data=V_flat)
+        g.attrs["fingerprint"] = (f"lobpcg|{dim}|{cols}|0"
+                                  f"|{_operator_key(eng)}|v1")
+        for k in ("topology_d", "topology_m", "partition_fp",
+                  "ckpt_version"):
+            del g.attrs[k]
+        del g["topology_counts"]
+    _, _, _ = lobpcg(eng.matvec, eng.n_states, k=1, tol=1e-9,
+                     max_iters=300, checkpoint_path=str(ck),
+                     checkpoint_every=50)
+    resumes = [e for e in obs.events("solver_resume")
+               if e.get("solver") == "lobpcg"]
+    assert resumes and resumes[-1]["iters"] == it_p, \
+        "v1 flat LOBPCG checkpoint did not warm-start"
+
+
+# ---------------------------------------------------------------------------
+# serve-layer elasticity (satellite)
+
+
+def test_pool_drops_warm_engine_on_mesh_shrink():
+    from distributed_matvec_tpu.serve import EnginePool, JobSpec
+
+    spec = JobSpec(job_id="el-pool",
+                   basis={"number_spins": 10, "hamming_weight": 5},
+                   k=1, mode="ell", n_devices=2)
+    pool = EnginePool(live_devices=4)
+    eng = pool.acquire(spec)
+    assert eng.n_devices == 2
+    # same topology: warm hit
+    assert pool.acquire(spec) is eng and pool.hits == 1
+    # the fleet shrinks under the pool: the warm engine must be dropped
+    # and rebuilt clamped to what exists
+    pool.live_devices = 1
+    eng1 = pool.acquire(spec)
+    assert eng1 is not eng and getattr(eng1, "n_devices", 1) == 1
+    evict = [e for e in obs.events("engine_pool")
+             if e.get("reason") == "mesh_mismatch"]
+    assert evict and evict[-1]["live_devices"] == 1
+    clamp = obs.events("engine_clamp")
+    assert clamp and clamp[-1]["requested_devices"] == 2
+    # the fleet REGROWS: the engine clamped during the shrink must not
+    # keep serving the spec undersized while admission prices the full
+    # live capacity — dropped and rebuilt at min(spec, live)
+    pool.live_devices = 4
+    eng4 = pool.acquire(spec)
+    assert eng4 is not eng1 and eng4.n_devices == 2
+
+
+def test_admission_prices_live_capacity():
+    from distributed_matvec_tpu.serve import (EnginePool, JobQueue,
+                                              JobSpec, Scheduler)
+
+    sched = Scheduler(queue=JobQueue(), pool=EnginePool(live_devices=1),
+                      rates=None, live_devices=1)
+    v = sched.admit(JobSpec(job_id="el-adm",
+                            basis={"number_spins": 10,
+                                   "hamming_weight": 5},
+                            mode="ell", n_devices=4))
+    assert v["live_devices"] == 1 and v["priced_devices"] == 1
+    adm = [e for e in obs.events("admission")
+           if e.get("job_id") == "el-adm"]
+    assert adm and adm[-1]["live_devices"] == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeat rank-set awareness (satellite)
+
+
+def test_heartbeat_ignores_and_ages_out_departed_ranks(tmp_path):
+    from distributed_matvec_tpu.parallel.heartbeat import HeartbeatWatchdog
+
+    hb_dir = tmp_path / "hb"
+    os.makedirs(hb_dir / "heartbeat")
+    # leftovers of a 4-rank era, stale for ages
+    old = time.time() - 3600
+    for r in (1, 2, 3):
+        p = hb_dir / "heartbeat" / f"rank_{r}.hb"
+        p.write_text("0.0\n")
+        os.utime(p, (old, old))
+    stalls = []
+    wd = HeartbeatWatchdog(str(hb_dir), interval_s=0.05, timeout_s=0.2,
+                           rank=0, n_ranks=2,
+                           on_stall=lambda rep: stalls.append(rep))
+    wd.start()
+    try:
+        # departed ranks' files swept on start; rank_1 (in set) kept
+        names = sorted(os.listdir(hb_dir / "heartbeat"))
+        assert "rank_2.hb" not in names and "rank_3.hb" not in names
+        # a live peer beats: no stall, and the scan never names a
+        # departed rank even past the grace window
+        deadline = time.time() + 1.0
+        while time.time() < deadline:
+            (hb_dir / "heartbeat" / "rank_1.hb").write_text(
+                f"{time.time():.3f}\n")
+            time.sleep(0.05)
+        assert not stalls, stalls
+        # the scan is scoped to the rank set by construction
+        report = wd.scan()
+        assert report is None
+    finally:
+        wd.stop()
+    # a NOT-YET-STALE out-of-set file is never swept: a live concurrent
+    # larger run's peers beat every interval_s, so their files are
+    # RECENT but still predate a freshly constructed watchdog — deleting
+    # one would open a one-beat window in which that run sees the file
+    # missing and aborts spuriously.  Staleness past timeout_s, not age
+    # relative to this watchdog, decides.
+    wd2 = HeartbeatWatchdog(str(hb_dir), timeout_s=60.0, rank=0, n_ranks=2,
+                            on_stall=lambda rep: None)
+    live = hb_dir / "heartbeat" / "rank_8.hb"
+    live.write_text("x\n")
+    recent = time.time() - 1.0          # beat 1 s ago — before wd2._t0
+    os.utime(live, (recent, recent))
+    fresh = hb_dir / "heartbeat" / "rank_9.hb"
+    fresh.write_text("x\n")
+    ahead = time.time() + 60
+    os.utime(fresh, (ahead, ahead))
+    wd2._age_out_departed()
+    assert live.exists() and fresh.exists()
+
+
+def test_heartbeat_still_reports_a_real_stall(tmp_path):
+    """Rank-set scoping must not swallow GENUINE stalls of live peers."""
+    from distributed_matvec_tpu.parallel.heartbeat import HeartbeatWatchdog
+
+    stalls = []
+    wd = HeartbeatWatchdog(str(tmp_path), interval_s=0.05, timeout_s=0.3,
+                           rank=0, n_ranks=2,
+                           on_stall=lambda rep: stalls.append(rep))
+    wd.start()
+    try:
+        deadline = time.time() + 3.0
+        while not stalls and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert stalls and stalls[0]["stalled"] == [1]
+
+
+# ---------------------------------------------------------------------------
+# the REAL 2-process leg
+
+
+def test_multihost_elastic_two_ranks(tmp_path):
+    """2-process run (multihost worker harness, elastic leg): each rank
+    writes a sharded checkpoint on a rank-local 4-device mesh (per-rank
+    ``.r*`` files at the OLD topology), then resumes the same solve on a
+    2-device mesh — the restore reshards across the multi-rank file
+    layout and the resumed E0 matches the exact ground state."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_ELASTIC"] = str(tmp_path)
+    procs = [subprocess.Popen(
+        [_sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
+        assert f"[p{pid}] elastic resumed E0/4" in out, out[-2000:]
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]

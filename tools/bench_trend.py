@@ -65,6 +65,7 @@ METRIC_WHITELIST = (
     "serve_solves_per_min", "serve_p50_latency_ms",
     "serve_p99_latency_ms", "serve_engine_builds", "serve_engine_hits",
     "serve_batch_speedup", "serve_e0_max_rel_err", "solo_wall_s",
+    "resume_reshard_s", "resume_rebuild_plan_s",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -86,19 +87,31 @@ METRIC_WHITELIST = (
 #: ``serve_p99_latency_ms`` cost-like) guards the solve service's
 #: throughput/latency: a PR that quietly halves serving throughput or
 #: doubles tail latency fails the gate even when single-solve walls hold.
+#: The elastic pair (``resume_reshard_s`` — the D→D′ checkpoint
+#: redistribution wall, ``resume_rebuild_plan_s`` — the per-D′ streamed
+#: plan rebuild on resume; both cost-like seconds under the shared
+#: direction table in distributed_matvec_tpu/obs/directions.py) guards
+#: the elastic-resume path: a PR that quietly makes topology-portable
+#: restores expensive fails the gate even when steady applies hold.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s", "compress_rel_err",
                 "compress_drift_max", "barrier_ms",
                 "pipelined_steady_apply_ms",
-                "serve_solves_per_min", "serve_p99_latency_ms")
+                "serve_solves_per_min", "serve_p99_latency_ms",
+                "resume_reshard_s", "resume_rebuild_plan_s")
 
 #: Absolute noise floors per gated metric: a baseline below the floor is
 #: scheduler jitter, not a trajectory (``barrier_ms`` on a healthy
 #: pipeline is sub-millisecond, where a 30% relative bound would gate
 #: pure noise against the all-time best) — such series are skipped, the
 #: same way exactly-zero baselines are.
-GATE_MIN_BASELINE = {"barrier_ms": 1.0}
+GATE_MIN_BASELINE = {"barrier_ms": 1.0,
+                     # elastic resume walls on the CPU rig are fractions
+                     # of a second; sub-50 ms baselines are scheduler
+                     # jitter, not a trajectory
+                     "resume_reshard_s": 0.05,
+                     "resume_rebuild_plan_s": 0.05}
 
 
 def _keep(metric: str) -> bool:
