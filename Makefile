@@ -14,7 +14,7 @@ HEALTH_THRESHOLD ?= 0.02
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
 	roofline-check compress-check trace-check pipeline-check \
-	serve-check elastic-check clean
+	hybrid-check serve-check elastic-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -25,6 +25,7 @@ check:
 	$(MAKE) compress-check
 	$(MAKE) roofline-check
 	$(MAKE) pipeline-check
+	$(MAKE) hybrid-check
 	$(MAKE) trace-check
 	$(MAKE) serve-check
 	$(MAKE) fault-check
@@ -127,6 +128,22 @@ roofline-check:
 # barrier_ms regression.  Deterministic, ~45 s on the CPU rig.
 pipeline-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/pipeline_check.py
+
+# Hybrid-split gate (tools/hybrid_check.py, DESIGN.md §28): degenerate
+# all-stream/all-recompute splits equal the existing streamed apply
+# bit-for-bit (plan bytes equal / strictly below), a pinned mixed split
+# stays bit-identical to pure streamed at pipeline depths {0, 2} with
+# counters preserved, the auto split prices deterministically at the
+# documented default rates (artifact cache off => no measured sidecar),
+# single-chunk hybrid plans resolve pipeline auto to sequential,
+# `obs_report diff --phases` shows plan_h2d bytes DOWN with the merged
+# exchange/accumulate counts exactly flat, the offline per-term pricer
+# reaches a genuine mix under the TPU rates (recommendation flips to
+# hybrid when it beats both pure tiers; price_job prices hybrid specs),
+# and the PROGRESS.jsonl trend gate fires on a synthetic 3x
+# hybrid_plan_bytes regression.  Deterministic, ~45 s on the CPU rig.
+hybrid-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/hybrid_check.py
 
 # Tracing gate (tools/trace_check.py): apply HLO byte-identity with
 # tracing on vs off (local ell; streamed result bit-identity rides

@@ -87,18 +87,22 @@ def _main(argv=None):
                          "stays in hashed space and eigenvectors are saved "
                          "per shard (vector_shards/eigenvector_<i>)")
     ap.add_argument("--mode", choices=("ell", "compact", "streamed",
-                                       "fused"),
+                                       "fused", "hybrid"),
                     default=None,
                     help="engine mode: precomputed structure (ell, the "
                          "default), 4 B/entry for isotropic real sectors "
                          "(compact), the structure resolved once into a "
                          "host-RAM plan streamed per apply (streamed — "
                          "fused-level device memory, no per-apply orbit "
-                         "scan; solved via the eager block-Lanczos), or "
+                         "scan; solved via the eager block-Lanczos), "
                          "recompute-on-the-fly (fused — the default with "
                          "--shards; plan builds also work shard-native, "
                          "streaming peer shards from the file, and are "
-                         "worth their one-time cost for long solves)")
+                         "worth their one-time cost for long solves), or "
+                         "the per-term recompute-vs-stream split priced "
+                         "by the calibrated cost model (hybrid — the "
+                         "DMT_HYBRID knob picks the split policy; solved "
+                         "via the eager block-Lanczos like streamed)")
     ap.add_argument("--block", action="store_true",
                     help="use LOBPCG (blocked) instead of Lanczos")
     ap.add_argument("--solver-checkpoint", default=None, metavar="CKPT_H5",
@@ -270,14 +274,14 @@ def _main(argv=None):
         print(f"basis: N={n} states "
               f"({'restored from' if restored else 'checkpointed to'} {out})")
 
-    if args.mode == "streamed":
+    if args.mode in ("streamed", "hybrid"):
         # fail BEFORE the engine pays the plan-resolution cost: pair-form
         # sectors (complex characters on a TPU mesh) have no in-tree
         # streamed solver — lanczos() cannot trace a streamed engine and
         # lanczos_block() has no J-aware reorthogonalization
         from distributed_matvec_tpu.parallel.engine import use_pair_complex
         if (not cfg.hamiltonian.effective_is_real) and use_pair_complex():
-            print("--mode streamed does not support pair-form complex "
+            print(f"--mode {args.mode} does not support pair-form complex "
                   "sectors (no streamed-compatible solver handles the "
                   "J-aware recurrence); use --mode ell/fused, or run the "
                   "sector native-c128 on CPU", file=sys.stderr)
@@ -286,11 +290,12 @@ def _main(argv=None):
     with timer.scope("engine"):
         if args.shards:
             pass                              # engine built above
-        elif (args.devices and args.devices > 1) or args.mode == "streamed":
+        elif (args.devices and args.devices > 1) \
+                or args.mode in ("streamed", "hybrid"):
             from distributed_matvec_tpu.parallel.distributed import (
                 DistributedEngine)
-            # streamed lives on DistributedEngine; without --devices it
-            # runs the documented single-device form (n_devices=1)
+            # streamed/hybrid live on DistributedEngine; without
+            # --devices they run the documented single-device form
             eng = DistributedEngine(cfg.hamiltonian,
                                     n_devices=args.devices or 1,
                                     mode=args.mode)
@@ -339,8 +344,8 @@ def _main(argv=None):
                            if e.get("solver") == "lobpcg"]
                 if resumed:
                     resumed_from = int(resumed[-1]["iters"])
-            elif args.mode == "streamed":
-                # a streamed engine cannot be traced into the
+            elif args.mode in ("streamed", "hybrid"):
+                # a streamed/hybrid engine cannot be traced into the
                 # single-program Lanczos block runner — drive it with the
                 # eager block solver (each k-column block streams the plan
                 # once)

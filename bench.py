@@ -33,12 +33,29 @@ def _progress(msg):
           flush=True)
 
 
-def _build_op(basis_args, n_sites, edges=None):
+def _build_op(basis_args, n_sites, edges=None, model="heisenberg"):
     from distributed_matvec_tpu.models.basis import SpinBasis
     from distributed_matvec_tpu.models.lattices import (
         chain_edges, heisenberg_from_edges)
 
     basis = SpinBasis(**basis_args)
+    if model == "tfxy":
+        # transverse-field XY ring (full 2^n space — σˣ breaks hamming):
+        # σᶻσᶻ bonds stay diagonal, the per-site σˣ fields are |G|=1
+        # always-firing off-diagonal terms (the recompute-class side of a
+        # hybrid split, DESIGN.md §28), and a few long-range XY bonds
+        # fire on ~half the rows (the streamed-class side)
+        from distributed_matvec_tpu.models.operator import Operator
+        sites = [list(e) for e in (edges if edges is not None
+                                   else chain_edges(n_sites))]
+        fields = [[i] for i in range(n_sites)]
+        xy = [[i, (i + n_sites // 2) % n_sites]
+              for i in range(0, n_sites, 4)]
+        return Operator.from_expressions(
+            basis,
+            [("-1.0 × σᶻ₀ σᶻ₁", sites), ("0.75 × σˣ₀", fields),
+             ("0.25 × σˣ₀ σˣ₁ + 0.25 × σʸ₀ σʸ₁", xy)],
+            name=f"TFXY(h=0.75) chain_{n_sites}")
     op = heisenberg_from_edges(
         basis, edges if edges is not None else chain_edges(n_sites))
     return op
@@ -291,7 +308,8 @@ def _bench_stream(name, *args, **kwargs):
 
 
 def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
-                       compress_tier="lossless"):
+                       compress_tier="lossless", model="heisenberg",
+                       hybrid_split=None):
     """Fused vs streamed vs compressed-streamed DistributedEngine on one
     config.
 
@@ -312,7 +330,14 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     measured ``barrier_ms`` time-at-barrier and ``overlap_fraction``
     from the apply_phases pipeline split, with bit-identity against
     fused riding along — ``barrier_ms`` and ``pipelined_steady_apply_ms``
-    join the default trend-gate set."""
+    join the default trend-gate set.  The fifth leg runs the HYBRID
+    engine (DESIGN.md §28; ``hybrid_split`` — default auto, priced off
+    the resolved calibration; the field configs pin ``"pairs"`` = stream
+    exactly the two-site XY terms, so their trend numbers don't flip
+    with the rig's calibration state) and records ``hybrid_plan_bytes``
+    / ``hybrid_steady_apply_ms`` / ``hybrid_stream_term_fraction`` /
+    ``hybrid_bit_identical`` (vs the streamed leg) — the first two join
+    the default trend-gate set."""
     import jax
 
     from distributed_matvec_tpu.obs.metrics import histogram as _hist
@@ -323,23 +348,31 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     n_sites = basis_args["number_spins"]
     obs.emit("bench_config_start", config=name)
     _progress(f"{name}: stream bench, building basis")
-    op = _build_op(basis_args, n_sites, edges)
+    op = _build_op(basis_args, n_sites, edges, model=model)
     make_or_restore_basis(op.basis)
     n = op.basis.number_states
     out = {"config": name, "n_states": n}
+    if hybrid_split == "pairs":
+        # pin the split at the TERM level, calibration-independent: the
+        # two-site XY terms stream, the single-site field terms recompute
+        # — the mixed split the tfxy model exists to measure
+        hybrid_split = "stream:" + ",".join(
+            map(str, op.off_diag_table.term_indices_by_flip_weight(2)))
     rng = np.random.default_rng(7)
     x = rng.standard_normal(n)
     x /= np.linalg.norm(x)
     y_ref = None
+    y_stream = None
     cfg = get_config()
     saved_tier = cfg.stream_compress
     # every leg pins its pipeline depth explicitly so the recorded
     # numbers keep their identity regardless of ambient DMT_PIPELINE
     legs = (("fused", None, 0), ("streamed", "off", 0),
-            ("compressed", compress_tier, 0), ("pipelined", "off", 4))
+            ("compressed", compress_tier, 0), ("pipelined", "off", 4),
+            ("hybrid", "off", 0))
     try:
         for leg, tier, pipe_depth in legs:
-            mode = "fused" if leg == "fused" else "streamed"
+            mode = leg if leg in ("fused", "hybrid") else "streamed"
             if tier is not None:
                 cfg.stream_compress = tier
             _progress(f"{name}: {leg} engine"
@@ -354,8 +387,11 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
             # itself to sequential and the leg records pipeline_depth=0 —
             # the honest reading; multi-chunk configs (the real targets)
             # exercise the pipeline
-            eng = DistributedEngine(op, n_devices=n_devices, mode=mode,
-                                    pipeline_depth=pipe_depth)
+            eng = DistributedEngine(
+                op, n_devices=n_devices, mode=mode,
+                pipeline_depth=pipe_depth,
+                **({"hybrid_split": hybrid_split}
+                   if leg == "hybrid" and hybrid_split else {}))
             init_s = time.perf_counter() - t0
             xh = eng.to_hashed(x)
             stall = _hist("plan_stream_stall_ms")
@@ -374,6 +410,7 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
             if leg == "fused":
                 y_ref = np.asarray(yh)
             elif leg == "streamed":
+                y_stream = np.asarray(yh)
                 out["stream_bit_identical"] = bool(
                     np.array_equal(y_ref, np.asarray(yh)))
                 out["plan_bytes"] = int(eng.plan_bytes_raw)
@@ -423,6 +460,18 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
                     if frac:
                         out["overlap_fraction"] = round(
                             sum(frac) / len(frac), 4)
+            elif leg == "hybrid":
+                # the per-term split leg (DESIGN.md §28): auto split
+                # priced off the resolved calibration, bit-identity
+                # gated against the pure-streamed leg (the §28
+                # contract), plan bytes + steady wall trend-gated
+                out["hybrid_bit_identical"] = bool(np.array_equal(
+                    y_stream if y_stream is not None else y_ref,
+                    np.asarray(yh)))
+                out["hybrid_plan_bytes"] = int(eng.plan_bytes)
+                out["hybrid_stream_term_fraction"] = round(
+                    float(eng.hybrid_stream_fraction), 4)
+                out["hybrid_split"] = str(eng._hybrid_split)
             else:
                 y_c = np.asarray(yh)
                 scale = max(float(np.max(np.abs(y_ref))), 1e-300)
@@ -453,6 +502,9 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     out["pipelined_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
         / max(out["pipelined_steady_apply_ms"], 1e-9), 2)
+    out["hybrid_steady_speedup"] = round(
+        out["fused_steady_apply_ms"]
+        / max(out["hybrid_steady_apply_ms"], 1e-9), 2)
     obs.emit("bench_result", **out)
     return out
 
@@ -584,6 +636,11 @@ CHAIN_24_SYMM = dict(number_spins=24, hamming_weight=12, spin_inversion=1,
 CHAIN_16_SYMM = dict(number_spins=16, hamming_weight=8, spin_inversion=1,
                      symmetries=[([*range(1, 16), 0], 0),
                                  ([*reversed(range(16))], 0)])
+#: transverse-field XY ring over the FULL 2^16 space (model="tfxy"): the
+#: hybrid stream bench's mixed-split config — 16 single-site σˣ terms
+#: (always firing, the recompute side) beside 2 long-range XY bonds (the
+#: streamed side), DESIGN.md §28
+CHAIN_16_FIELD = dict(number_spins=16)
 
 
 def _probe_device(timeout_s: int = 180) -> bool:
@@ -722,6 +779,12 @@ def _main():
                 "stream_chain_16_symm", CHAIN_16_SYMM, repeats=10)
         except Exception as e:
             detail["stream_chain_16_symm"] = {"error": repr(e)}
+        try:
+            detail["stream_chain_16_field"] = _bench_stream(
+                "stream_chain_16_field", CHAIN_16_FIELD, repeats=10,
+                model="tfxy", hybrid_split="pairs")
+        except Exception as e:
+            detail["stream_chain_16_field"] = {"error": repr(e)}
     elif args.cpu_fallback:
         # Dead-chip round: run every config that is CPU-feasible (same
         # config keys as the recorded full run, minus chain_32_symm whose
@@ -756,6 +819,12 @@ def _main():
                 "stream_chain_24_symm", CHAIN_24_SYMM, repeats=5)
         except Exception as e:
             detail["stream_chain_24_symm"] = {"error": repr(e)}
+        try:
+            detail["stream_chain_16_field"] = _bench_stream(
+                "stream_chain_16_field", CHAIN_16_FIELD, repeats=5,
+                model="tfxy", hybrid_split="pairs")
+        except Exception as e:
+            detail["stream_chain_16_field"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_24_symm", CHAIN_24_SYMM,
@@ -798,6 +867,12 @@ def _main():
                 "stream_chain_24_symm", CHAIN_24_SYMM, repeats=5)
         except Exception as e:
             detail["stream_chain_24_symm"] = {"error": repr(e)}
+        try:
+            detail["stream_chain_16_field"] = _bench_stream(
+                "stream_chain_16_field", CHAIN_16_FIELD, repeats=5,
+                model="tfxy", hybrid_split="pairs")
+        except Exception as e:
+            detail["stream_chain_16_field"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_32_symm", CHAIN_32_SYMM,

@@ -69,6 +69,16 @@ class OffDiagTable:
     def max_inner(self) -> int:
         return 0 if self.v.size == 0 else self.v.shape[1]
 
+    def term_indices_by_flip_weight(self, weight: int) -> List[int]:
+        """Indices of the term groups whose flip mask moves exactly
+        ``weight`` sites (1 = single-site fields, 2 = two-site exchange,
+        …).  Indexes THIS table's term order — the order every per-term
+        consumer (the hybrid engine's ``stream:`` splits, the plan
+        codec's term mask) sees — so callers never re-derive it from a
+        re-sorted mask list."""
+        return [i for i, m in enumerate(self.x.tolist())
+                if bin(int(m)).count("1") == weight]
+
     def apply(self, alphas: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Dense [B,T] (betas, amplitudes) for each α (host/NumPy).
 

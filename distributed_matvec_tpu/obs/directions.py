@@ -19,6 +19,15 @@ the elastic-resume walls ``resume_reshard_s`` / ``resume_rebuild_plan_s``
 (``make elastic-check``): time spent redistributing a checkpoint or
 rebuilding a per-D′ plan on resume is a cost, so growth gates under the
 default rule; register them here (by falling through) exactly once.
+
+The hybrid-mode trio registers the same way (``make hybrid-check``,
+DESIGN.md §28): ``hybrid_plan_bytes`` and ``hybrid_steady_apply_ms``
+are cost-like — encoded partial-term plan bytes or the merged chunk
+program's wall growing is the regression — and deliberately fall
+through to the default; ``hybrid_stream_term_fraction`` rides the
+trend as CONTEXT (which side of the priced split the terms landed on),
+not a gated direction — neither growth nor shrinkage is a regression
+per se, the priced split is whatever the rates make it.
 """
 
 from __future__ import annotations

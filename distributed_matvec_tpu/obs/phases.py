@@ -65,14 +65,24 @@ __all__ = [
 ORBIT_OPS = 16
 
 #: Canonical phase order (reports render in this order; ``overhead`` is
-#: derived at report time and carries no structural counts).
-PHASES = ("plan_h2d", "compute", "exchange", "accumulate", "overhead")
+#: derived at report time and carries no structural counts).  The two
+#: ``compute_*`` phases are HYBRID mode's split of ``compute``
+#: (DESIGN.md §28): ``compute_decode`` is the streamed term subset's
+#: decode + x-row gather + multiply, ``compute_recompute`` the recompute
+#: subset's on-device orbit scan + routing + multiply — the roofline
+#: report prices each against its own resource, so a mispriced split
+#: shows up as one of them running far off its bound.  Non-hybrid modes
+#: keep the single ``compute`` phase (trend continuity).
+PHASES = ("plan_h2d", "compute", "compute_decode", "compute_recompute",
+          "exchange", "accumulate", "overhead")
 
 #: The hardware resource each phase is bound by — what a roofline report
 #: names when a phase dominates.
 PHASE_RESOURCE = {
     "plan_h2d": "h2d bandwidth",
     "compute": "gather rate",
+    "compute_decode": "gather rate",
+    "compute_recompute": "flop rate (orbit scan)",
     "exchange": "interconnect bandwidth",
     "accumulate": "scatter rate",
     "overhead": "host dispatch",
@@ -92,9 +102,12 @@ def phases_enabled() -> bool:
 
 def zero_counts() -> Dict[str, Dict[str, int]]:
     """A fresh all-zero per-phase count dict (``overhead`` excluded — it
-    carries no structural counts by definition)."""
+    carries no structural counts by definition; the hybrid-only
+    ``compute_*`` split phases excluded too — only the hybrid engine adds
+    them, so every other mode's events keep their exact historical key
+    set)."""
     return {p: {"bytes": 0, "gathers": 0, "flops": 0}
-            for p in PHASES if p != "overhead"}
+            for p in ("plan_h2d", "compute", "exchange", "accumulate")}
 
 
 def emit_apply_phases(engine: str, mode: str, apply_index: int,

@@ -39,6 +39,8 @@ import json
 import os
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..utils.logging import log_debug, log_warn
 from .phases import PHASE_RESOURCE, PHASES
 
@@ -52,6 +54,8 @@ __all__ = [
     "phase_bounds_ms",
     "attribute_phases",
     "choose_pipeline_depth",
+    "price_term_split",
+    "choose_hybrid_split",
     "roofline_report",
     "print_roofline",
     "reconcile_error",
@@ -184,6 +188,10 @@ def phase_bounds_ms(phases: Dict[str, dict], cal: dict) -> Dict[str, float]:
 
     * ``plan_h2d``   bytes / h2d_bytes_per_s
     * ``compute``    gathers / gather_rows_per_s + flops / flops_per_s
+      (same formula for the hybrid split pair ``compute_decode`` /
+      ``compute_recompute`` — the decode side carries gathers, the
+      recompute side orbit-scan flops, so each prices at its own
+      resource)
     * ``exchange``   bytes / exchange_bytes_per_s
     * ``accumulate`` SCATTER_WEIGHT · gathers / gather_rows_per_s
 
@@ -199,7 +207,7 @@ def phase_bounds_ms(phases: Dict[str, dict], cal: dict) -> Dict[str, float]:
         f = float(c.get("flops", 0))
         if p == "plan_h2d":
             t = by / h
-        elif p == "compute":
+        elif p in ("compute", "compute_decode", "compute_recompute"):
             t = ga / g + f / fl
         elif p == "exchange":
             t = by / x
@@ -289,7 +297,11 @@ def choose_pipeline_depth(counts: Dict[str, dict], cal: dict,
     total = sum(bounds.values())
     if total <= 0:
         return 0
-    comp = bounds.get("compute", 0.0)
+    # hybrid mode splits compute into decode/recompute phases — the
+    # overlappable compute is their sum
+    comp = (bounds.get("compute", 0.0)
+            + bounds.get("compute_decode", 0.0)
+            + bounds.get("compute_recompute", 0.0))
     exch = bounds.get("exchange", 0.0) if n_devices > 1 else 0.0
     h2d = bounds.get("plan_h2d", 0.0)
     hideable = min(comp, exch) * (1.0 - 1.0 / nchunks) + h2d
@@ -297,6 +309,61 @@ def choose_pipeline_depth(counts: Dict[str, dict], cal: dict,
         return 0
     depth = AUTO_PIPELINE_DEEP if h2d >= 0.5 * hideable else 2
     return min(depth, nchunks)
+
+
+def price_term_split(live_per_term, rows: int, group_order: int,
+                     cal: dict, bytes_per_live_entry: float,
+                     cplx: bool = False) -> dict:
+    """Per-term recompute-vs-stream pricing — the hybrid mode's cost
+    model (DESIGN.md §28), shared verbatim by the engine's ``auto``
+    split, ``tools/capacity.py``'s ``--hybrid`` table, and the tests, so
+    all three answer the same question from the same rates.
+
+    Per term ``t`` (all times in ms, per apply, across all ``rows``
+    padded basis rows):
+
+    * **stream**: the term's plan slice travels H2D and decodes —
+      ``live[t] · (bytes_per_live_entry / h2d + 1/gather + fmul/flops)``
+      (each live entry is streamed bytes, one ``x[row]`` gather, and the
+      multiply);
+    * **recompute**: the term's structure is re-derived on device —
+      ``rows · ((G·ORBIT_OPS + fmul) / flops)`` (the orbit scan runs on
+      every row whether or not the term fires there; the send side is a
+      row-major broadcast, no gather).
+
+    ``live_per_term`` is the global live-entry census ([T] ints, summed
+    over chunks/shards/ranks); ``rows`` the matching global padded row
+    total (each term is scanned once per row).  Returns ``{stream_ms,
+    recompute_ms, stream_mask}`` — ``stream_mask[t]`` True when
+    streaming term ``t`` prices cheaper or equal."""
+    from .phases import ORBIT_OPS
+
+    live = np.asarray(live_per_term, np.float64).reshape(-1)
+    g = float(cal["gather_rows_per_s"])
+    h = float(cal["h2d_bytes_per_s"])
+    fl = float(cal["flops_per_s"])
+    fmul = 8.0 if cplx else 2.0
+    per_entry_s = bytes_per_live_entry / h + 1.0 / g + fmul / fl
+    stream_ms = live * per_entry_s * 1e3
+    recompute_ms = np.full(
+        live.shape,
+        float(rows) * (max(int(group_order), 1) * ORBIT_OPS + fmul)
+        / fl * 1e3)
+    return {"stream_ms": stream_ms, "recompute_ms": recompute_ms,
+            "stream_mask": stream_ms <= recompute_ms}
+
+
+def choose_hybrid_split(live_per_term, rows: int, group_order: int,
+                        cal: dict, bytes_per_live_entry: float,
+                        cplx: bool = False) -> np.ndarray:
+    """The ``hybrid="auto"`` policy: stream exactly the terms whose plan
+    slice prices cheaper than re-deriving their structure on device
+    (:func:`price_term_split`).  Deterministic in (census, rates), so
+    every rank of a multi-controller job — and a later warm restore under
+    the same fingerprint — resolves the identical mask."""
+    return np.asarray(
+        price_term_split(live_per_term, rows, group_order, cal,
+                         bytes_per_live_entry, cplx)["stream_mask"], bool)
 
 
 def _mean(vals: List[float]) -> float:
@@ -348,7 +415,9 @@ def roofline_report(events: List[dict],
         binding = max(attributed,
                       key=lambda p: attributed[p]["bound_ms"]) \
             if bound_total > 0 else "overhead"
-        comp = attributed.get("compute", {}).get("wall_ms", 0.0)
+        comp = sum(attributed.get(p, {}).get("wall_ms", 0.0)
+                   for p in ("compute", "compute_decode",
+                             "compute_recompute"))
         exch = attributed.get("exchange", {}).get("wall_ms", 0.0)
         overlap = min(comp, exch) * (1.0 - 1.0 / nchunks) \
             if nchunks > 1 else 0.0

@@ -295,15 +295,26 @@ class PlanCodec:
     def build(cls, tier: str, chunks, n_dest: int, cap_build: int,
               n_devices: int, shard_size: int, cshape, ckind: str,
               agree: Optional[Callable] = None,
-              dict_max: int = DICT_MAX) -> "PlanCodec":
+              dict_max: int = DICT_MAX,
+              term_mask: Optional[np.ndarray] = None) -> "PlanCodec":
         """Codec for a freshly built plan.  ``chunks`` is the engine's
         ``[{shard: pc}]`` raw-chunk list; the scan measures the live-entry
         census (compaction bound), the true maximum bucket fill (capacity
         trim), and the distinct-coefficient census (dictionary decision).
         ``agree`` (multi-controller) maps the local decisions to job-wide
         ones — the encoded operand shapes enter a collective program, so
-        every rank must encode identically."""
+        every rank must encode identically.
+
+        ``term_mask`` (hybrid mode, DESIGN.md §28) is a [T] bool array
+        marking which terms' entries are STORED (True = streamed); the
+        other terms are recomputed on device per apply.  The capacity trim
+        still measures ALL live entries — the merged slot layout is the
+        full plan's, so the streamed entries' stored slots stay exactly
+        the slots the full-streamed apply would use and the recompute side
+        fills the per-bucket complement — while the dest/row/coeff streams
+        (and the dictionary) carry only the masked subset."""
         D = int(n_devices)
+        T = int(cshape[1])
         spec = {"version": PLAN_CODEC_VERSION, "tier": tier,
                 "n_dest": int(n_dest), "D": D,
                 "cap_build": int(cap_build), "cap_eff": int(cap_build),
@@ -314,8 +325,23 @@ class PlanCodec:
                 "n_live": int(n_dest),
                 "cshape": [int(s) for s in cshape], "ckind": ckind,
                 "coeff": "raw", "code_bits": 0, "ndict": 0}
+        if term_mask is not None:
+            term_mask = np.asarray(term_mask, bool).reshape(-1)
+            if term_mask.size != T:
+                raise ValueError(
+                    f"term_mask has {term_mask.size} entries for "
+                    f"{T} terms")
+            spec["hybrid"] = True
+            spec["stream_terms"] = [int(t) for t in
+                                    np.nonzero(term_mask)[0]]
+            if tier == "off":
+                raise ValueError(
+                    "a term-masked (hybrid) plan requires a compacted "
+                    "tier — the raw [B, T] layout cannot drop terms")
         if tier == "off":
             return cls(spec)
+        mask_flat = None if term_mask is None \
+            else np.tile(term_mask, int(cshape[0]))
         uniq: Dict[int, np.ndarray] = {}
         n_live = 0
         fill = 0
@@ -328,13 +354,17 @@ class PlanCodec:
                 # the build already validated to zero)
                 dest_all = np.asarray(pc["dest"], np.int64).reshape(-1)
                 live = (flat != 0) & (dest_all < D * cap_build)
-                n_live = max(n_live, int(live.sum()))
                 dest = dest_all[live]
                 if dest.size:
                     # in-bucket rank: dead entries sit in their own
                     # bucket (the D·Cap sentinel), so live positions are
-                    # consecutive per bucket and max(pos)+1 is the fill
+                    # consecutive per bucket and max(pos)+1 is the fill.
+                    # ALL live entries count here even under a term mask:
+                    # the trim defines the merged slot space
                     fill = max(fill, int((dest % cap_build).max()) + 1)
+                if mask_flat is not None:
+                    live &= mask_flat
+                n_live = max(n_live, int(live.sum()))
                 u = np.unique(flat[live])
                 prev = uniq.get(d)
                 uniq[d] = u if prev is None else \
@@ -422,9 +452,20 @@ class PlanCodec:
 
     # -- compaction (host) ------------------------------------------------
 
+    def term_mask(self) -> Optional[np.ndarray]:
+        """The [T] bool stream mask of a hybrid (term-masked) codec, None
+        otherwise — reconstructed from the spec so a sidecar restore
+        carries the split without a separate payload field."""
+        if not self.spec.get("hybrid"):
+            return None
+        mask = np.zeros(int(self.spec["cshape"][1]), bool)
+        mask[np.asarray(self.spec.get("stream_terms", []), np.int64)] = True
+        return mask
+
     def compact_raw(self, pc: Dict) -> Dict:
         """One raw (chunk, shard) record → its compacted host-side form:
-        live entries only, trimmed exchange slots, explicit row indices.
+        live entries only (the masked term subset for a hybrid codec),
+        trimmed exchange slots, explicit row indices.
         The shared oracle of :meth:`encode_chunk` and the round-trip
         tests.  Keys: ``dest``/``row``/``coeff`` ([n_live], canonical
         f64/c128 coeff, pads: drop-sentinel / 0 / 0) and
@@ -436,6 +477,9 @@ class PlanCodec:
         flat = _canonical(pc["coeff"], s["ckind"])
         dest_all = np.asarray(pc["dest"], np.int64).reshape(-1)
         live = (flat != 0) & (dest_all < D * cap_b)   # build's definition
+        mask = self.term_mask()
+        if mask is not None:
+            live &= np.tile(mask, int(s["cshape"][0]))
         dest = dest_all[live]
         if dest.size > nl:
             raise ValueError(

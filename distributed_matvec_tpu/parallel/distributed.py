@@ -54,7 +54,26 @@ Three modes, mirroring :class:`~.engine.LocalEngine`:
   bandwidth.  Bit-identical to ``fused`` for single vectors and k ≤ 4
   batches (same chunking, same bucket math, same accumulation order).
 
-Both chunked modes (fused, streamed) additionally accept ``pipeline_depth``
+* ``"hybrid"`` — the per-term recompute-vs-stream split (DESIGN.md §28):
+  each Hamiltonian term takes whichever tier is cheapest for *it*, priced
+  by the calibrated roofline (``obs/roofline.choose_hybrid_split`` —
+  recompute flops at the measured flop rate vs encoded plan bytes +
+  decode gathers at the measured H2D/gather rates).  The build resolves
+  the FULL structure once (exactly the streamed build), then stores only
+  the streamed term subset's compressed plan slices — plan bytes and
+  build-output volume shrink by the recompute share — while the chunk
+  program re-derives the cheap terms' structure on device beside the
+  streamed terms' decode and merges both into ONE send buffer: the
+  recompute entries take, per exchange bucket, exactly the slots the
+  streamed entries left free, which are provably the full plan's merged
+  slots — so the apply stays bit-identical to pure streamed (the gate)
+  while the split mix compiles as one static program per fingerprint
+  (GSPMD's one-program argument, PAPERS.md).  Split policy via
+  ``DMT_HYBRID`` / ``hybrid_split=`` (auto | all-stream | all-recompute |
+  stream:<terms>); the resolved mask is baked into fingerprint v4.
+
+The chunked modes (fused, streamed, hybrid) additionally accept
+``pipeline_depth``
 (``DMT_PIPELINE``, DESIGN.md §25): a software pipeline that keeps chunk
 *i*'s amplitude exchange in flight while chunk *i+1*'s local
 gather/multiply runs — plan fetches prefetched by worker threads,
@@ -339,7 +358,8 @@ class DistributedEngine:
                  structure_cache: Optional[str] = None,
                  layout: Optional[HashedLayout] = None,
                  shards_path: Optional[str] = None,
-                 pipeline_depth=None):
+                 pipeline_depth=None,
+                 hybrid_split=None):
         _t_init = time.perf_counter()
         basis = operator.basis
         #: True when the representatives came from the artifact-cache
@@ -348,7 +368,7 @@ class DistributedEngine:
         self.basis_restored = False
         cfg = get_config()
         mode = mode or cfg.matvec_mode
-        if mode not in ("ell", "compact", "fused", "streamed"):
+        if mode not in ("ell", "compact", "fused", "streamed", "hybrid"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:
             raise ValueError("the engine requires a Hermitian operator")
@@ -686,6 +706,24 @@ class DistributedEngine:
                         f"unknown stream_kernel {cfg.stream_kernel!r}; "
                         "pick auto|xla|pallas")
                 self._stream_kernel = "xla" if sk == "auto" else sk
+                #: hybrid mode's resolved [T] stream mask (True = the
+                #: term's entries travel in the plan stream, False = the
+                #: term recomputes on device inside the chunk program);
+                #: None for the pure streamed mode.  Resolved by policy
+                #: (and, for "auto", the per-term cost model over the
+                #: build census) or restored from the sidecar codec spec.
+                self._hybrid_mask: Optional[np.ndarray] = None
+                #: the codec tier the plan actually encodes at: the
+                #: configured stream_compress tier, except that hybrid
+                #: plans REQUIRE a compacted encoding (a term subset
+                #: cannot ride the raw [B, T] layout), so compress "off"
+                #: maps to "lossless" — value-exact f64 decode, still
+                #: bit-identical to the off-tier streamed apply
+                self._codec_tier = self._compress
+                if mode == "hybrid":
+                    if self._compress == "off":
+                        self._codec_tier = "lossless"
+                    self._init_hybrid_policy(hybrid_split)
                 stream_cache = self._resolve_structure_cache(structure_cache)
                 self.structure_restored = agree_restored(
                     self._try_load_stream_plan(stream_cache))
@@ -697,6 +735,9 @@ class DistributedEngine:
                             annotate("engine_init/build_plan"):
                         try:
                             self._build_stream_plan(row_provider)
+                            if mode == "hybrid":
+                                self._hybrid_mask = \
+                                    self._resolve_hybrid_mask()
                             self._encode_stream_plan()
                         except Exception as e:
                             if not obs_memory.is_resource_exhausted(e):
@@ -709,6 +750,8 @@ class DistributedEngine:
                     self._emit_plan_reshard(cache_arg,
                                             time.perf_counter() - _t_build)
                 self._upload_codec_tables()
+                if mode == "hybrid":
+                    self._setup_hybrid_recompute()
                 self._register_stream_plan()
                 import weakref
                 weakref.finalize(self, _close_plan_files, self._plan_files)
@@ -717,9 +760,9 @@ class DistributedEngine:
                 self._matvec = self._make_streamed_matvec()
                 # overflow/invalid are structural and validated at plan time
                 # (build or restore) — applies revalidate nothing
-                self._last_program_key = "streamed"
+                self._last_program_key = mode
                 self._last_capacity = self._capacity
-                self._checked.add("streamed")
+                self._checked.add(mode)
         # per-rank shard census — the survivor-count column of the
         # cross-rank skew table (`obs_report report --ranks`): how many
         # basis states this rank's addressable shards actually carry
@@ -1306,7 +1349,7 @@ class DistributedEngine:
             hash_basis_operator(h, self.operator)
         h.update(f"dist|{self.mode}|{self.pair}|{self.real}"
                  f"|{self.n_devices}|{self.shard_size}|v2".encode())
-        if self.mode == "streamed":
+        if self.mode in ("streamed", "hybrid"):
             # the plan's dest/exchange layout bakes in the row-chunk size
             # and the per-peer capacity; a knob change must miss, not
             # restore a plan whose scatter targets no longer fit
@@ -1319,6 +1362,19 @@ class DistributedEngine:
             h.update(f"|B{self.batch_size}|cap{self._capacity}"
                      f"|p{self._lk_probes}|c{self._compress}"
                      f"|codec{PLAN_CODEC_VERSION}|v3".encode())
+        if self.mode == "hybrid":
+            # v4: the TERM MASK enters the content hash (DESIGN.md §28) —
+            # a changed hybrid_split must MISS cleanly, never misread a
+            # partial-term plan encoded for a different split.  Pinned
+            # splits hash their explicit policy string; the "auto" split
+            # is a deterministic function of (structure, calibration
+            # rates), so the rates themselves stand in for the mask —
+            # re-calibrating re-keys the plan.  The effective codec tier
+            # rides along (hybrid maps compress "off" to the compacted
+            # lossless encoding).  v3-era streamed sidecars carry a
+            # different mode string entirely, so they miss-and-rebuild.
+            h.update(self._hybrid_token().encode())
+            h.update(f"|tier{self._codec_tier}|v4".encode())
         self._fp_cache = h.hexdigest()
         return self._fp_cache
 
@@ -1749,12 +1805,13 @@ class DistributedEngine:
 
         D = self.n_devices
         self._codec = PC.PlanCodec.build(
-            self._compress, self._plan_chunks,
+            self._codec_tier, self._plan_chunks,
             n_dest=self.batch_size * self.num_terms,
             cap_build=self._capacity, n_devices=D,
             shard_size=self.shard_size,
             cshape=self._codec_cshape(), ckind=self._codec_ckind(),
-            agree=self._codec_agree if self._multi else None)
+            agree=self._codec_agree if self._multi else None,
+            term_mask=self._hybrid_mask)
         enc_bytes = 0
         nrec = 0
         spec = self._codec.spec
@@ -1782,10 +1839,201 @@ class DistributedEngine:
         self.plan_bytes_raw = self._codec.raw_chunk_bytes() * nrec
         self.plan_bytes = enc_bytes
         log_debug(
-            f"stream plan encoded: tier={self._compress} "
+            f"stream plan encoded: tier={self._codec_tier} "
             f"coeff={self._codec.spec['coeff']} "
             f"{self.plan_bytes_raw / 1e6:.1f} -> {enc_bytes / 1e6:.1f} MB "
             f"({self.plan_bytes_raw / max(enc_bytes, 1):.2f}x)")
+
+    # -- hybrid mode: per-term recompute-vs-stream split (DESIGN.md §28) ---
+
+    def _init_hybrid_policy(self, hybrid_split) -> None:
+        """Resolve and validate the split POLICY before the fingerprint is
+        taken (constructor argument > ``config.hybrid`` / ``DMT_HYBRID``).
+        The "auto" policy additionally pins the calibration it will price
+        with — the rates enter the fingerprint, so a re-calibrated rig
+        re-keys (and re-splits) the plan instead of restoring a plan built
+        for different economics."""
+        cfg = get_config()
+        s = str(hybrid_split if hybrid_split is not None
+                else cfg.hybrid).strip().lower() or "auto"
+        if s not in ("auto", "all-stream", "all-recompute") \
+                and not s.startswith("stream:"):
+            raise ValueError(
+                f"bad hybrid split {s!r}: pick auto | all-stream | "
+                "all-recompute | stream:<term,term,...> "
+                "(DMT_HYBRID / config.hybrid)")
+        self._hybrid_split = s
+        self._static_hybrid_mask()      # explicit lists validate eagerly
+        self._hybrid_cal = None
+        if s == "auto":
+            from ..obs import roofline as _roofline
+            self._hybrid_cal = _roofline.resolve_calibration()
+
+    def _hybrid_token(self) -> str:
+        """The fingerprint's split token: the policy string, plus — for
+        "auto" — the calibration rates the split was priced with (the
+        mask is a deterministic function of both, so together with the
+        structure hash they pin it exactly)."""
+        tok = self._hybrid_split
+        if self._hybrid_split == "auto" and self._hybrid_cal is not None:
+            from ..obs import roofline as _roofline
+            tok += "|" + ",".join(
+                f"{k}={float(self._hybrid_cal.get(k) or 0):.6g}"
+                for k in _roofline.RATE_FIELDS)
+        return f"|hyb[{tok}]"
+
+    def _static_hybrid_mask(self) -> Optional[np.ndarray]:
+        """The [T] stream mask of a policy that needs no census
+        (all-stream / all-recompute / an explicit ``stream:`` list);
+        None for "auto" (resolved from the build census instead)."""
+        T = self.num_terms
+        s = self._hybrid_split
+        if s == "all-stream":
+            return np.ones(T, bool)
+        if s == "all-recompute":
+            return np.zeros(T, bool)
+        if s.startswith("stream:"):
+            mask = np.zeros(T, bool)
+            idx = [int(t) for t in s[len("stream:"):].split(",")
+                   if t.strip()]
+            bad = [t for t in idx if not 0 <= t < T]
+            if bad:
+                raise ValueError(
+                    f"hybrid stream terms {bad} outside [0, {T})")
+            mask[idx] = True
+            return mask
+        return None
+
+    def _hybrid_group_order(self) -> int:
+        """|G| for the recompute pricing: the per-entry orbit-scan cost
+        scales with the symmetry group order (1 when the basis needs no
+        projection — the cheap-orbit regime where recompute shines)."""
+        if self.tables.group is None:
+            return 1
+        grp = getattr(self.operator.basis, "group", None)
+        return max(len(grp), 1) if grp is not None else 1
+
+    def _hybrid_entry_bytes(self) -> float:
+        """Modeled encoded bytes ONE live streamed entry puts on the
+        per-apply H2D stream: the bitpacked (dest, row) index pair plus
+        the tier's coefficient bytes (u16 dictionary code expected for
+        the lossless/off tiers on repeating-coefficient sectors — the
+        optimistic end, which biases auto toward streaming, the
+        conservative direction for wall-clock).  The shared-per-chunk
+        ridx/rok layout is excluded: it streams regardless of the
+        split."""
+        from ..ops import plan_codec as PC
+
+        w = PC.bits_for(self.n_devices * self._capacity) \
+            + PC.bits_for(max(self.batch_size - 1, 1))
+        ncomp = 2 if (self.pair or not self.real) else 1
+        coeff_b = {"lossless": 2.0, "f32": 4.0 * ncomp,
+                   "bf16": 2.0 * ncomp}.get(self._codec_tier, 2.0)
+        return w / 8.0 + coeff_b
+
+    def _hybrid_census(self):
+        """Global per-term live-entry counts of the freshly built raw
+        plan (the auto split's input): ``(counts [T], rows)`` summed over
+        chunks, shards, and ranks.  Multi-controller runs allgather the
+        census so every rank prices — and therefore splits — identically;
+        backends without multiprocess host computations degrade to the
+        deterministic all-stream split everywhere (same contract as
+        ``_codec_agree``)."""
+        from ..ops.plan_codec import _canonical
+
+        T = self.num_terms
+        ckind = self._codec_ckind()
+        lim = self.n_devices * self._capacity
+        counts = np.zeros(T, np.int64)
+        rows = 0
+        for per in self._plan_chunks:
+            for pc in per.values():
+                flat = _canonical(pc["coeff"], ckind)
+                dest = np.asarray(pc["dest"], np.int64).reshape(-1)
+                live = (flat != 0) & (dest < lim)
+                counts += live.reshape(-1, T).sum(axis=0)
+                rows += self.batch_size
+        if not self._multi:
+            return counts, rows
+        try:
+            from jax.experimental import multihost_utils as mhu
+            payload = np.concatenate([counts, [rows]]).astype(np.int64)
+            tot = np.sum(np.atleast_2d(mhu.process_allgather(payload)),
+                         axis=0)
+            return tot[:T], int(tot[T])
+        except Exception as e:
+            log_debug(f"hybrid census agreement unavailable ({e!r}); "
+                      "falling back to the all-stream split on all ranks")
+            return None, 0
+
+    def _resolve_hybrid_mask(self) -> np.ndarray:
+        """The resolved [T] stream mask for this build: the pinned policy
+        mask, or — for "auto" — the per-term priced split
+        (:func:`~..obs.roofline.choose_hybrid_split`: recompute flops at
+        the calibrated flop rate vs encoded plan bytes + decode gathers
+        at the calibrated H2D/gather rates)."""
+        mask = self._static_hybrid_mask()
+        if mask is None:
+            from ..obs import roofline as _roofline
+            counts, rows = self._hybrid_census()
+            if counts is None:       # no cross-rank census: deterministic
+                mask = np.ones(self.num_terms, bool)
+            else:
+                mask = _roofline.choose_hybrid_split(
+                    counts, rows, self._hybrid_group_order(),
+                    self._hybrid_cal, self._hybrid_entry_bytes(),
+                    cplx=self.pair or not self.real)
+        log_debug(f"hybrid split ({self._hybrid_split}): "
+                  f"{int(mask.sum())}/{mask.size} terms streamed, "
+                  f"{int((~mask).sum())} recomputed on device")
+        return np.asarray(mask, bool)
+
+    def _setup_hybrid_recompute(self) -> None:
+        """Device operands of the recompute side, built once per engine:
+        the recompute-term subset of the operator tables (row-sliced — the
+        per-term kernels are independent across terms, so the sliced scan
+        reproduces the build's values bit-for-bit) and the engine's
+        basis/norm rows padded to the plan's chunk grid (the chunk
+        program dynamic-slices both exactly as it slices ``x``)."""
+        mask = self._hybrid_mask
+        sel = np.nonzero(~mask)[0]
+        self._hyb_n_recompute = int(sel.size)
+        self.hybrid_stream_fraction = float(mask.mean()) if mask.size \
+            else 1.0
+        if sel.size:
+            sel_d = jnp.asarray(sel, jnp.int32)
+            off = self.tables.off
+            # trim trailing all-zero inner-kernel columns: the full table
+            # pads every term group to the global K_max, but the
+            # recompute subset is typically the CHEAP terms (the auto
+            # split's whole point), whose groups hold fewer kernels.  A
+            # zero-v column contributes exactly 0 to the (v·sign·ok) sum,
+            # so the trim is bit-exact while cutting the per-(row, term)
+            # kernel work to the subset's true K.
+            kv = self.operator.off_diag_table.v[sel]
+            knz = np.nonzero((kv != 0).any(axis=0))[0]
+            kmax = int(knz.max()) + 1 if knz.size else 1
+            sub = K.OffDiagKernelTables(
+                x=off.x[sel_d], v=off.v[sel_d, :kmax],
+                s=off.s[sel_d, :kmax], m=off.m[sel_d, :kmax],
+                r=off.r[sel_d, :kmax])
+            self._hyb_tables = K.OperatorTables(
+                diag=self.tables.diag, off=sub, group=self.tables.group)
+        else:
+            self._hyb_tables = self.tables      # unused (all-stream)
+        M, Mp = self.shard_size, self._plan_nchunks_v * self.batch_size
+        if Mp > M:
+            sh2 = shard_spec(self.mesh, 2)
+            self._hyb_alphas = jax.jit(
+                lambda a: jnp.pad(a, ((0, 0), (0, Mp - M)),
+                                  constant_values=SENTINEL_STATE),
+                out_shardings=sh2)(self._alphas)
+            self._hyb_norms = jax.jit(
+                lambda a: jnp.pad(a, ((0, 0), (0, Mp - M)),
+                                  constant_values=1.0),
+                out_shardings=sh2)(self._norms)
+        else:
+            self._hyb_alphas, self._hyb_norms = self._alphas, self._norms
 
     def _upload_codec_tables(self) -> None:
         """Stage the per-shard coefficient dictionaries on the mesh — ONCE
@@ -1822,16 +2070,31 @@ class DistributedEngine:
         from ..obs import gauge
         gauge("stream_plan_bytes").set(int(self.plan_bytes))
         raw = int(getattr(self, "plan_bytes_raw", 0) or self.plan_bytes)
+        hyb_ctx = {}
+        if self.mode == "hybrid":
+            # the split's identity card, read by tools/capacity.py
+            # snapshots and the hybrid bench leg: which fraction of the
+            # terms travel in the stream, under which policy
+            hyb_ctx = {"hybrid_split": str(self._hybrid_split),
+                       "stream_terms": int(self._hybrid_mask.sum()),
+                       "num_terms": int(self.num_terms),
+                       "stream_term_fraction":
+                       round(float(self.hybrid_stream_fraction), 4)}
         emit("plan_stream", engine="distributed", tier=tier,
+             mode=self.mode,
              plan_bytes=int(self.plan_bytes),
              plan_bytes_raw=raw,
-             compress=str(getattr(self, "_compress", "off")),
+             # the EFFECTIVE codec tier — for hybrid plans compress "off"
+             # maps to the compacted lossless encoding, and the reported
+             # bytes are that encoding's, so the event must say so
+             compress=str(getattr(self, "_codec_tier",
+                                  getattr(self, "_compress", "off"))),
              compress_ratio=round(raw / max(int(self.plan_bytes), 1), 4),
              chunks=int(self._plan_nchunks_v),
              capacity=int(self._capacity), batch=int(self.batch_size),
              overflow=int(self._stream_overflow),
              invalid=int(self._stream_invalid),
-             host_rss_bytes=obs_memory.host_rss_bytes())
+             host_rss_bytes=obs_memory.host_rss_bytes(), **hyb_ctx)
 
     def _save_stream_plan(self, path: Optional[str], soft: bool = False
                           ) -> None:
@@ -1948,13 +2211,28 @@ class DistributedEngine:
             codec = PC.PlanCodec.from_spec_json(scalars["codec_spec"])
         except (ValueError, KeyError):
             return False          # future codec format: miss and rebuild
-        if (codec.spec["tier"] != self._compress
+        if (codec.spec["tier"] != self._codec_tier
                 or codec.spec["n_dest"]
                 != self.batch_size * self.num_terms
                 or codec.spec["cap_build"] != self._capacity
                 or codec.spec["D"] != self.n_devices
                 or codec.spec["ckind"] != self._codec_ckind()):
             return False
+        # a partial-term (hybrid) plan must NEVER be misread as a full
+        # streamed plan (or vice versa): the spec's hybrid flag must match
+        # the engine mode, and for the policy-pinned splits the stored
+        # stream-term set must equal the policy's (the auto split is
+        # pinned by the fingerprint's calibration token instead — the
+        # census that produced it is deterministic per structure+rates)
+        if bool(codec.spec.get("hybrid")) != (self.mode == "hybrid"):
+            return False
+        if self.mode == "hybrid":
+            want = self._static_hybrid_mask()
+            got = codec.term_mask()
+            if got is None or got.size != self.num_terms:
+                return False
+            if want is not None and not np.array_equal(got, want):
+                return False
         # group shards per candidate so each sidecar opens ONCE for the
         # sizing pass and once for the RAM load — a chain_32-class plan
         # has hundreds of (chunk, shard) datasets, and per-dataset reopen
@@ -1982,6 +2260,8 @@ class DistributedEngine:
                 note_artifact_corrupt(cand, "stream_plan", e)
                 return False
         self._codec = codec
+        if self.mode == "hybrid":
+            self._hybrid_mask = codec.term_mask()
         self._plan_nchunks_v = nchunks
         self.plan_bytes = plan_bytes
         self.plan_bytes_raw = codec.raw_chunk_bytes() \
@@ -2234,7 +2514,7 @@ class DistributedEngine:
         priced overlappable time is worth the bookkeeping).  Single-
         program plan modes (ell/compact) have no chunk sequence to
         pipeline and always resolve 0."""
-        if self.mode not in ("fused", "streamed"):
+        if self.mode not in ("fused", "streamed", "hybrid"):
             return 0
         val = self._pipeline_req
         if val is None:
@@ -2278,12 +2558,86 @@ class DistributedEngine:
         from ..ops import plan_codec as PC
         spec = self._codec.spec
         tier_off = spec["tier"] == "off"
+        # hybrid mode (DESIGN.md §28): the chunk program carries a second,
+        # recompute side — the non-streamed terms' orbit scan + routing —
+        # whose amplitudes merge into the SAME send buffer (and therefore
+        # the same staged exchange) as the decoded streamed entries
+        hyb = self.mode == "hybrid"
+        n_rec = self._hyb_n_recompute if hyb else 0
         # the apply runs at the codec's TRIMMED exchange capacity: the
         # build sized buckets for the worst case, the finished plan knows
         # the true max fill (cap_eff == cap_build for the off tier)
         cap_apply = int(spec["cap_eff"])
         n_recv = D * cap_apply
         pallas_interp = self.mesh.devices.flat[0].platform != "tpu"
+
+        def make_recompute(tail):
+            """HYBRID's recompute side for one chunk: re-derive the
+            non-streamed terms' structure on device (the same
+            ``gather_coefficients`` + ``_bucket_positions`` math the plan
+            build ran, restricted to the recompute term subset — the
+            per-term kernels are independent across terms, so the values
+            are bit-identical to the build's) and scatter the amplitudes
+            into the merged send buffer.
+
+            The merged exchange slot is recovered WITHOUT streaming it:
+            in the full plan each bucket's live entries occupy the slot
+            prefix [0, fill) in flattened (row, term) order, so the
+            recompute entries' slots are exactly the per-bucket
+            complement of the streamed entries' stored slots, taken in
+            increasing order — the j-th recompute entry of a bucket (by
+            the recompute-only in-bucket rank, a preserved subsequence of
+            the full order) lands on the bucket's j-th free slot.  That
+            makes the hybrid send buffer — and every exchanged and
+            accumulated bit after it — identical to the full-streamed
+            apply's."""
+            nbt = len(tail) - len(ptail)
+
+            def add_recompute(send_a, x_c, a_c, n_c, ht, dest_s):
+                betas, gcoeff = K.gather_coefficients(ht, a_c, n_c)
+                valid_row = (a_c != SENTINEL_STATE)[:, None]
+                if is_pair:
+                    nz = (gcoeff != 0).any(axis=-1) & valid_row
+                    cf = jnp.where(nz[..., None], K.conj_pair(gcoeff), 0)
+                else:
+                    nz = (gcoeff != 0) & valid_row
+                    cf = jnp.where(nz, jnp.conj(gcoeff), 0)
+                flat_b = betas.reshape(-1)
+                live = nz.reshape(-1)
+                owner = (hash64(flat_b) % jnp.uint64(D)).astype(jnp.int32) \
+                    if D > 1 else jnp.zeros(flat_b.shape, jnp.int32)
+                key = jnp.where(live, owner, D)
+                pos = _bucket_positions(key, D)
+                # free-slot table from the streamed entries' occupancy:
+                # slot_of[k·cap + j] = the j-th unoccupied slot of bucket
+                # k (dest_s pads carry the n_recv sentinel and drop out)
+                occ = jnp.zeros(n_recv, jnp.int32).at[dest_s].set(
+                    1, mode="drop")
+                free = 1 - occ.reshape(D, cap_apply)
+                fr = jnp.cumsum(free, axis=1)
+                buck = jax.lax.broadcasted_iota(
+                    jnp.int32, (D, cap_apply), 0)
+                cols = jax.lax.broadcasted_iota(
+                    jnp.int32, (D, cap_apply), 1)
+                tgt = jnp.where(free > 0, buck * cap_apply + (fr - 1),
+                                n_recv)
+                slot_of = jnp.zeros(n_recv, jnp.int32).at[
+                    tgt.reshape(-1)].set(cols.reshape(-1), mode="drop")
+                safe = (jnp.clip(key, 0, D - 1) * cap_apply
+                        + jnp.minimum(pos, cap_apply - 1))
+                dest_r = jnp.where(live & (pos < cap_apply),
+                                   key * cap_apply + slot_of[safe], n_recv)
+                x_t = x_c[:, None]                   # [B, 1] + tail
+                if is_pair:
+                    g_t = cf[:, :, None, :] if nbt else cf
+                    amps = K.cmul_pair(g_t, x_t)
+                else:
+                    g_t = cf[:, :, None] if nbt else cf
+                    amps = g_t * x_t
+                return send_a.at[dest_r].set(
+                    amps.reshape((-1,) + tail), mode="drop")
+
+            return add_recompute
 
         def make_decode_send(tail):
             """One chunk's SEND side as a pure function of (x slice,
@@ -2298,14 +2652,19 @@ class DistributedEngine:
             nbt = len(tail) - len(ptail)   # number of batch axes (0 or 1)
             # the explicit Pallas kernel covers the dict-coded real-sector
             # single-column stream (the bench/gate shape); every other
-            # shape decodes through the XLA-ops path, which the compiler
-            # fuses into the chunk program anyway
+            # shape — hybrid chunks included (their recompute side merges
+            # after the decode, the documented fallback) — decodes through
+            # the XLA-ops path, which the compiler fuses into the chunk
+            # program anyway
             use_pallas = (self._stream_kernel == "pallas"
-                          and not tier_off
+                          and not tier_off and not hyb
                           and spec["coeff"] == "dict"
                           and self.real and tail == ())
+            add_recompute = make_recompute(tail) if (hyb and n_rec) \
+                else None
 
-            def decode_send(x_c, dest, coeff, ridx, rok, cdict):
+            def decode_send(x_c, dest, coeff, ridx, rok, cdict,
+                            a_c=None, n_c=None, ht=None):
                 if use_pallas:
                     # fused decode+gather+multiply+scatter in one kernel;
                     # same arithmetic, so the result is bit-identical to
@@ -2354,6 +2713,14 @@ class DistributedEngine:
                     send_a = jnp.zeros((n_recv,) + tail,
                                        dtype).at[dest_].set(
                         amps, mode="drop")
+                    if add_recompute is not None:
+                        # hybrid: the recompute terms' amplitudes land in
+                        # the same buffer at their merged (full-plan)
+                        # slots — disjoint from the streamed entries', so
+                        # the scatter order between the two sides cannot
+                        # change a bit
+                        send_a = add_recompute(send_a, x_c, a_c, n_c, ht,
+                                               dest_)
                 return send_a, ridx_, rok_
 
             return decode_send
@@ -2390,16 +2757,32 @@ class DistributedEngine:
                     diag.shape + (1,) * len(tail)) * x.astype(dtype))
             return pad_prog, zeros_prog, epi_prog
 
+        # hybrid: every chunk program takes three extra operands — the
+        # padded basis/norm rows (sharded, dynamic-sliced per chunk like
+        # x) and the recompute-term table subset (replicated, like the
+        # fused program's tables).  Non-hybrid programs keep their exact
+        # historical signature.
+        hyb_specs = (_pspec(2), _pspec(2), P()) if hyb else ()
+
+        def slice_hyb(start, hargs):
+            if not hyb:
+                return (None, None, None)
+            ap, nn, ht = hargs
+            return (jax.lax.dynamic_slice(ap[0], (start,), (B,)),
+                    jax.lax.dynamic_slice(nn[0], (start,), (B,)), ht)
+
         def make_programs(tail):
             decode_send = make_decode_send(tail)
 
-            def shard_body(xp, y, start, dest, coeff, ridx, rok, cdict):
+            def shard_body(xp, y, start, dest, coeff, ridx, rok, cdict,
+                           *hargs):
                 xp_, y_ = xp[0], y[0]
                 zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
                 x_c = jax.lax.dynamic_slice(
                     xp_, (start,) + zeros, (B,) + tail)
                 send_a, ridx_, rok_ = decode_send(
-                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0],
+                    *slice_hyb(start, hargs))
                 if D > 1:
                     recv_a = jax.lax.all_to_all(
                         send_a.reshape((D, cap_apply) + tail), SHARD_AXIS,
@@ -2411,16 +2794,18 @@ class DistributedEngine:
 
             nd = 2 + len(tail)
 
-            def chunk_fn(xp, y, start, dest, coeff, ridx, rok, cdict):
+            def chunk_fn(xp, y, start, dest, coeff, ridx, rok, cdict,
+                         *hargs):
                 f = shard_map_compat(
                     shard_body, mesh=mesh,
                     in_specs=(_pspec(nd), _pspec(nd), P(),
                               _pspec(dest.ndim), _pspec(coeff.ndim),
                               _pspec(ridx.ndim), _pspec(rok.ndim),
-                              _pspec(cdict.ndim)),
+                              _pspec(cdict.ndim)) + hyb_specs,
                     out_specs=_pspec(nd),
                 )
-                return f(xp, y, start, dest, coeff, ridx, rok, cdict)
+                return f(xp, y, start, dest, coeff, ridx, rok, cdict,
+                         *hargs)
 
             chunk_prog = jax.jit(chunk_fn, donate_argnums=(1,))
             return (chunk_prog,) + make_io_progs(tail)
@@ -2438,24 +2823,26 @@ class DistributedEngine:
             decode_send = make_decode_send(tail)
             nd = 2 + len(tail)
 
-            def send_body(xp, start, dest, coeff, ridx, rok, cdict):
+            def send_body(xp, start, dest, coeff, ridx, rok, cdict,
+                          *hargs):
                 zeros = tuple(jnp.zeros((), start.dtype) for _ in tail)
                 x_c = jax.lax.dynamic_slice(
                     xp[0], (start,) + zeros, (B,) + tail)
                 send_a, _, _ = decode_send(
-                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0])
+                    x_c, dest[0], coeff[0], ridx[0], rok[0], cdict[0],
+                    *slice_hyb(start, hargs))
                 return send_a[None]
 
-            def send_fn(xp, start, dest, coeff, ridx, rok, cdict):
+            def send_fn(xp, start, dest, coeff, ridx, rok, cdict, *hargs):
                 f = shard_map_compat(
                     send_body, mesh=mesh,
                     in_specs=(_pspec(nd), P(),
                               _pspec(dest.ndim), _pspec(coeff.ndim),
                               _pspec(ridx.ndim), _pspec(rok.ndim),
-                              _pspec(cdict.ndim)),
+                              _pspec(cdict.ndim)) + hyb_specs,
                     out_specs=_pspec(2 + len(tail)),
                 )
-                return f(xp, start, dest, coeff, ridx, rok, cdict)
+                return f(xp, start, dest, coeff, ridx, rok, cdict, *hargs)
 
             def exch_body(y, send, ridx, rok):
                 y_, s_ = y[0], send[0]
@@ -2483,6 +2870,8 @@ class DistributedEngine:
 
         programs: dict = {}
         pipe_programs: dict = {}
+        hyb_ops = (self._hyb_alphas, self._hyb_norms, self._hyb_tables) \
+            if hyb else ()
 
         def run_cols(x):
             tail = tuple(x.shape[2:])
@@ -2520,7 +2909,7 @@ class DistributedEngine:
                         entry["stall_ms"] = round(stall_ms, 4)
                     _td = time.perf_counter()
                     y = chunk_prog(xp, y, jnp.int32(ci * B), *pending,
-                                   self._cdict_dev)
+                                   self._cdict_dev, *hyb_ops)
                     if timeline is not None:
                         entry["dispatch_ms"] = round(
                             (time.perf_counter() - _td) * 1e3, 4)
@@ -2638,7 +3027,8 @@ class DistributedEngine:
                         # documented `depth` send buffers, not `depth`
                         # full plan chunks
                         sends[ci] = (send_prog(xp, jnp.int32(ci * B),
-                                               *staged, self._cdict_dev),
+                                               *staged, self._cdict_dev,
+                                               *hyb_ops),
                                      staged[2], staged[3])
                         if ci >= d - 1:
                             y = retire(ci - (d - 1), y)
@@ -2676,7 +3066,7 @@ class DistributedEngine:
                      for s in range(0, k, 4)], axis=2)
             else:
                 y = run_group(x)
-            self._last_program_key = "streamed"
+            self._last_program_key = self.mode
             self._last_capacity = Cap
             return (y, jnp.asarray(self._stream_overflow, jnp.int64),
                     jnp.asarray(self._stream_invalid, jnp.int64))
@@ -3301,7 +3691,7 @@ class DistributedEngine:
             obs_health.drain()
             idx = self._apply_idx
             self._apply_idx += 1
-            if self.mode in ("fused", "streamed"):
+            if self.mode in ("fused", "streamed", "hybrid"):
                 # streamed counters are the build-time structural totals —
                 # constant per plan, but the obs series must stay visible
                 # (zero being the healthy reading) exactly as in fused mode
@@ -3309,7 +3699,7 @@ class DistributedEngine:
                                                    overflow, invalid)
             if obs_health.probe_due(idx):
                 obs_health.probe_apply("distributed", y, idx)
-                if self.mode == "streamed" \
+                if self.mode in ("streamed", "hybrid") \
                         and self._compress in ("f32", "bf16"):
                     # lossy-tier drift sample rides the same cadence: a
                     # solve-long compress_rel_err series catches the
@@ -3332,7 +3722,7 @@ class DistributedEngine:
                     tail_elems *= int(s)
                 k = tail_elems // 2 if self.pair else tail_elems
                 timeline = measured = pipe = None
-                if self.mode == "streamed":
+                if self.mode in ("streamed", "hybrid"):
                     timeline = self._stream_timeline or None
                     self._stream_timeline = []
                     if timeline:
@@ -3378,7 +3768,7 @@ class DistributedEngine:
     def _nchunks(self) -> int:
         """Row chunks one apply streams through (1 for the single-program
         ell/compact plans)."""
-        if self.mode == "streamed":
+        if self.mode in ("streamed", "hybrid"):
             return int(self._plan_nchunks_v)
         if self.mode == "fused":
             B = self._last_program_key or self.batch_size
@@ -3431,9 +3821,9 @@ class DistributedEngine:
         else:
             nch = self._nchunks()
             Cap = self._last_capacity or self._capacity
-            B = self.batch_size if self.mode == "streamed" \
+            B = self.batch_size if self.mode in ("streamed", "hybrid") \
                 else int(self._last_program_key or self.batch_size)
-            if self.mode == "streamed":
+            if self.mode in ("streamed", "hybrid"):
                 # the codec sets the apply's real geometry: trimmed
                 # exchange capacity, and (compressed tiers) live entries
                 # only — the structural counts must match the work the
@@ -3445,13 +3835,32 @@ class DistributedEngine:
             c["accumulate"] = {"bytes": seg * vb * k, "gathers": seg,
                                "flops": seg * k * (2 if cplx else 1)}
             ent = nmy * nch * B * T
-            if self.mode == "streamed":
+            if self.mode in ("streamed", "hybrid"):
                 if spec["tier"] != "off":
                     ent = nmy * nch * int(spec["n_live"])
                 ngroups = -(-k // 4) if k > 4 else 1
                 c["plan_h2d"]["bytes"] = int(self.plan_bytes) * ngroups
-                c["compute"] = {"bytes": ent * vb * k, "gathers": 0,
-                                "flops": ent * k * fmul}
+                if self.mode == "hybrid":
+                    # the split's two compute sides, priced separately
+                    # (DESIGN.md §28): the decode side is live streamed
+                    # entries (each an explicit x[row] gather + multiply),
+                    # the recompute side runs the orbit scan on every
+                    # (row, recompute-term) pair — the same per-term cost
+                    # model the auto split priced, so `obs_report
+                    # roofline` shows where the chosen split lands versus
+                    # its bound
+                    ent_r = nmy * nch * B * self._hyb_n_recompute
+                    G = self._hybrid_group_order()
+                    c["compute_decode"] = {"bytes": ent * vb * k,
+                                           "gathers": ent,
+                                           "flops": ent * k * fmul}
+                    c["compute_recompute"] = {
+                        "bytes": ent_r * vb * k, "gathers": 0,
+                        "flops": ent_r * (k * fmul
+                                          + G * obs_phases.ORBIT_OPS)}
+                else:
+                    c["compute"] = {"bytes": ent * vb * k, "gathers": 0,
+                                    "flops": ent * k * fmul}
             else:
                 grp = getattr(self.operator.basis, "group", None)
                 G = max(len(grp), 1) if grp is not None else 1
@@ -3480,7 +3889,7 @@ class DistributedEngine:
         nmy = self._n_my_shards
         if self.mode in ("ell", "compact"):
             return nmy * D * self.query_capacity * tail_elems * 8
-        if self.mode == "streamed":
+        if self.mode in ("streamed", "hybrid"):
             # amplitudes only: the receive side already holds its layout,
             # so the betas no longer travel (half the fused exchange for
             # real sectors) — at the codec's TRIMMED capacity (== the
@@ -3655,9 +4064,9 @@ class DistributedEngine:
         single-vector Lanczos block runner, LOBPCG) is refused: use
         :func:`~..solve.lanczos.lanczos_block`, whose eager block applies
         stream each plan chunk once per k-column block."""
-        if self.mode == "streamed":
+        if self.mode in ("streamed", "hybrid"):
             raise NotImplementedError(
-                "streamed engines cannot be traced into an outer jitted "
+                f"{self.mode} engines cannot be traced into an outer jitted "
                 "program (the plan lives in host RAM and streams per "
                 "apply); use solve.lanczos_block, which applies the "
                 "engine eagerly one multi-RHS block at a time")
@@ -3693,7 +4102,7 @@ class DistributedEngine:
         out = {"operator_tables": self.tables,
                "basis_rows": (self._alphas, self._norms),
                "diag": self._diag}
-        if self.mode in ("fused", "streamed"):
+        if self.mode in ("fused", "streamed", "hybrid"):
             out["lookup"] = (self._lk_pair, self._lk_dir)
         for name, arrs in self.structure_arrays().items():
             out[f"structure/{name}"] = arrs
@@ -3705,7 +4114,7 @@ class DistributedEngine:
         :meth:`LocalEngine.apply_memory_analysis`.  None for streamed
         engines: the apply is a host-driven program sequence, not one
         compiled executable."""
-        if self.mode == "streamed":
+        if self.mode in ("streamed", "hybrid"):
             return None
         if xh is None:
             shape = (self.n_devices, self.shard_size) \

@@ -673,15 +673,15 @@ class LocalEngine:
             self.basis_restored = make_or_restore_basis(basis)
         cfg = get_config()
         mode = mode or cfg.matvec_mode
-        if mode == "streamed":
+        if mode in ("streamed", "hybrid"):
             # mode selection is shared with DistributedEngine via
             # cfg.matvec_mode; point at the engine that implements it
             # instead of an opaque unknown-mode error
             raise ValueError(
-                "mode='streamed' lives on DistributedEngine (the plan "
+                f"mode={mode!r} lives on DistributedEngine (the plan "
                 "stream reuses its exchange machinery) — use "
-                "DistributedEngine(op, n_devices=1, mode='streamed') for "
-                "a single-device streamed engine")
+                f"DistributedEngine(op, n_devices=1, mode={mode!r}) for "
+                "a single-device engine")
         if mode not in ("ell", "fused", "compact"):
             raise ValueError(f"unknown engine mode {mode!r}")
         if not operator.is_hermitian:

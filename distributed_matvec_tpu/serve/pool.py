@@ -62,7 +62,8 @@ def build_engine(spec: JobSpec, mesh=None, live_devices=None):
                  requested_devices=n_devices,
                  live_devices=int(live_devices))
         n_devices = int(live_devices)
-    if mesh is None and n_devices in (0, 1) and spec.mode != "streamed":
+    if mesh is None and n_devices in (0, 1) \
+            and spec.mode not in ("streamed", "hybrid"):
         from ..parallel.engine import LocalEngine
         return LocalEngine(op, mode=spec.mode)
     from ..parallel.distributed import DistributedEngine

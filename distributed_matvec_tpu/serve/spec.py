@@ -124,6 +124,7 @@ class JobSpec:
         basis build."""
         n = self.n_states
         num_terms = None
+        group_order = 1
         if self.basis is not None:
             ns = int(self.basis.get("number_spins", 0))
             if n is None:
@@ -132,10 +133,31 @@ class JobSpec:
             # edge (the chain default has one edge per site)
             num_terms = 2 * (len(self.edges) if self.edges is not None
                              else ns)
+            # |G| estimate for the hybrid recompute pricing (DESIGN.md
+            # §28): the product of the generator orders — exact for the
+            # standard chain sectors (translation · reversal ·
+            # inversion), an upper bound in general, which is the
+            # CONSERVATIVE direction (overpriced recompute biases the
+            # split toward streaming)
+            for perm, _sector in self.basis.get("symmetries") or ():
+                seen, order = set(), 1
+                for start in range(len(perm)):
+                    if start in seen:
+                        continue
+                    clen, j = 0, start
+                    while j not in seen:
+                        seen.add(j)
+                        j = perm[j]
+                        clen += 1
+                    order = order * clen // math.gcd(order, clen)
+                group_order *= max(order, 1)
+            if self.basis.get("spin_inversion"):
+                group_order *= 2
         return {"n_states": n, "num_terms": num_terms,
                 "mode": self.mode, "n_devices": max(int(self.n_devices), 1),
                 "pair": False, "k": int(self.k),
-                "max_iters": int(self.max_iters)}
+                "max_iters": int(self.max_iters),
+                "group_order": int(group_order)}
 
     # -- JSON --------------------------------------------------------------
 

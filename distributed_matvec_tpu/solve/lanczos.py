@@ -904,7 +904,7 @@ def _lanczos_block_impl(
     """
     owner = getattr(matvec, "__self__", None)
     if bool(getattr(owner, "pair", False)):
-        streamed = getattr(owner, "mode", None) == "streamed"
+        streamed = getattr(owner, "mode", None) in ("streamed", "hybrid")
         raise ValueError(
             "lanczos_block does not support pair-mode engines "
             "(J-aware reorthogonalization lives in lanczos())"
@@ -1339,13 +1339,13 @@ def _lanczos_impl(
     owner = getattr(matvec, "__self__", None)
     if pair is None:
         pair = bool(getattr(owner, "pair", False))
-    if getattr(owner, "mode", None) == "streamed":
+    if getattr(owner, "mode", None) in ("streamed", "hybrid"):
         raise ValueError(
             "lanczos() traces the matvec into one jitted block program, "
-            "which a streamed engine cannot provide (its plan lives in "
-            "host RAM and streams per apply) — use solve.lanczos_block, "
-            "whose eager multi-RHS block applies stream each plan chunk "
-            "once per block")
+            "which a streamed/hybrid engine cannot provide (its plan "
+            "lives in host RAM and streams per apply) — use "
+            "solve.lanczos_block, whose eager multi-RHS block applies "
+            "stream each plan chunk once per block")
     if reorth is None:
         from ..utils.config import get_config
         reorth = get_config().lanczos_reorth

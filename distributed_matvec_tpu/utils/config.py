@@ -82,7 +82,11 @@ class RuntimeConfig:
     #   "compact" (4 B/entry, isotropic real sectors) | "streamed"
     #   (DistributedEngine: fused-class structure resolved once into a
     #   host-RAM plan, streamed H2D per apply — no per-apply orbit scan) |
-    #   "fused" (recompute structure every apply)
+    #   "fused" (recompute structure every apply) | "hybrid"
+    #   (DistributedEngine: per-term recompute-vs-stream split priced by
+    #   the calibrated cost model — cheap-orbit terms recompute on device
+    #   beside the streamed terms' decode, one merged exchange; see the
+    #   `hybrid` knob below and DESIGN.md §28)
     stream_plan_ram_gb: float = 8.0        # host-RAM budget for a streamed
     #   engine's resolved plan; beyond it the plan is demoted to the
     #   artifact-cache sidecar (disk tier) and chunks are read back per
@@ -111,6 +115,19 @@ class RuntimeConfig:
     #   obs/roofline.choose_pipeline_depth).  Accumulation order is
     #   UNCHANGED at any depth, so pipelined applies stay bit-identical
     #   to sequential ones (gated by `make pipeline-check`)
+    hybrid: str = "auto"                   # hybrid-mode term split policy
+    #   (DMT_HYBRID, DESIGN.md §28): which Hamiltonian terms a
+    #   mode="hybrid" DistributedEngine STREAMS (compressed plan slices)
+    #   versus RECOMPUTES on device inside the chunk program — "auto"
+    #   prices every term off the calibrated roofline (recompute flops at
+    #   the measured flop rate vs encoded plan bytes + decode gathers at
+    #   the measured H2D/gather rates, obs/roofline.choose_hybrid_split),
+    #   "all-stream" / "all-recompute" pin the degenerate splits (equal
+    #   to the pure streamed / pure recompute tiers — gate-tested), and
+    #   "stream:i,j,..." pins an explicit streamed term set (tests and
+    #   controlled experiments).  The resolved split is baked into the
+    #   engine fingerprint (v4), so each split mix compiles and caches
+    #   as its own static program
     stream_kernel: str = "auto"            # compressed-chunk decode path
     #   (DMT_STREAM_KERNEL): "auto" (currently = xla), "xla" (decode ops
     #   traced into the chunk program — XLA fuses unpack+gather+multiply+

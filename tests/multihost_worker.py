@@ -203,6 +203,48 @@ if os.environ.get("DMT_MH_SERVE"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_HYBRID"):
+    # Hybrid-split leg (tests/test_engine_hybrid.py): a streamed and a
+    # hybrid engine per rank over a RANK-LOCAL mesh (the CPU backend
+    # cannot run cross-process computations — same constraint as every
+    # fast leg here) inside a real 2-process jax.distributed job.  The
+    # env value is the hybrid_split policy (a pinned mixed split by
+    # default: the census/codec agreement paths still exercise, and the
+    # split is deterministic per rank by construction).  The hybrid
+    # apply must equal the streamed apply BIT-for-bit on both ranks, its
+    # partial-term plan must be smaller than the streamed (same-tier)
+    # plan, and correctness is still asserted against the host truth so
+    # a broken merge cannot masquerade as a bytes win.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.utils.config import update_config
+
+    split = os.environ["DMT_MH_HYBRID"]
+    update_config(stream_compress="lossless")
+    eng_s = DistributedEngine(op,
+                              mesh=make_mesh(devices=jax.local_devices()),
+                              mode="streamed", batch_size=64)
+    eng_h = DistributedEngine(op,
+                              mesh=make_mesh(devices=jax.local_devices()),
+                              mode="hybrid", batch_size=64,
+                              hybrid_split=split)
+    ys = np.asarray(eng_s.matvec(eng_s.to_hashed(x)))
+    yh = np.asarray(eng_h.matvec(eng_h.to_hashed(x)))
+    assert np.array_equal(ys, yh), "hybrid lost bit-identity to streamed"
+    err = float(np.abs(eng_h.from_hashed(yh) - want).max())
+    print(f"[p{pid}] hybrid split={split}: max err {err:.3e}, "
+          f"plan {eng_h.plan_bytes} vs streamed {eng_s.plan_bytes} B",
+          flush=True)
+    assert err < 1e-12, err
+    assert 0.0 < eng_h.hybrid_stream_fraction < 1.0, \
+        eng_h.hybrid_stream_fraction
+    assert eng_h.plan_bytes < eng_s.plan_bytes, \
+        (eng_h.plan_bytes, eng_s.plan_bytes)
+    print(f"[p{pid}] HYBRID_PLAN_BYTES {eng_h.plan_bytes} "
+          f"{eng_s.plan_bytes}", flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

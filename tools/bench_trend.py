@@ -61,6 +61,9 @@ METRIC_WHITELIST = (
     "compress_steady_speedup", "compress_rel_err", "compress_drift_max",
     "pipelined_steady_apply_ms", "pipelined_steady_speedup",
     "barrier_ms", "overlap_fraction", "pipeline_depth",
+    "hybrid_plan_bytes", "hybrid_steady_apply_ms",
+    "hybrid_steady_speedup", "hybrid_stream_term_fraction",
+    "hybrid_bit_identical",
     "serve_jobs", "serve_jobs_done", "serve_wall_s",
     "serve_solves_per_min", "serve_p50_latency_ms",
     "serve_p99_latency_ms", "serve_engine_builds", "serve_engine_hits",
@@ -93,11 +96,19 @@ METRIC_WHITELIST = (
 #: direction table in distributed_matvec_tpu/obs/directions.py) guards
 #: the elastic-resume path: a PR that quietly makes topology-portable
 #: restores expensive fails the gate even when steady applies hold.
+#: The hybrid pair (``hybrid_plan_bytes`` — the partial-term plan's
+#: encoded bytes, ``hybrid_steady_apply_ms`` — its steady apply wall;
+#: both cost-like under the shared direction table in
+#: distributed_matvec_tpu/obs/directions.py) guards the per-term split:
+#: a PR that quietly streams terms the split priced as recompute (bytes
+#: creep back up) or slows the merged chunk program fails the gate even
+#: when the pure tiers hold.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s", "compress_rel_err",
                 "compress_drift_max", "barrier_ms",
                 "pipelined_steady_apply_ms",
+                "hybrid_plan_bytes", "hybrid_steady_apply_ms",
                 "serve_solves_per_min", "serve_p99_latency_ms",
                 "resume_reshard_s", "resume_rebuild_plan_s")
 

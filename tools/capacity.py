@@ -111,12 +111,13 @@ def load_structure(path: str) -> dict:
 
 def mode_bytes_per_row(T0: int, pair: bool) -> Dict[str, float]:
     """The analytic per-row structure cost of each mode (DEVICE bytes;
-    streamed keeps no resident structure on device — its plan lives in
-    host RAM, see :func:`stream_plan_bytes_per_row`)."""
+    streamed/hybrid keep no resident structure on device — their plans
+    live in host RAM, see :func:`stream_plan_bytes_per_row`)."""
     cf = 16 if pair else 8
     return {"ell": T0 * (4 + cf),
             "compact": T0 * 4 + 20,
             "streamed": 0.0,
+            "hybrid": 0.0,
             "fused": 0.0}
 
 
@@ -133,6 +134,25 @@ LIVE_FRACTION = 0.55
 #: ``1 − 1/nchunks`` factor needs a chunk count, and the planner has no
 #: engine in hand.
 PIPELINE_CHUNK_ROWS = 1 << 16
+
+#: Modeled SPREAD of per-term live fractions for the offline hybrid
+#: split (DESIGN.md §28): real operators' terms fire at different rates
+#: (the measured 48% dead share on chain_24_symm is an AVERAGE over
+#: terms), so the planner spreads the per-term liveness linearly over
+#: ``LIVE_FRACTION · [1−spread, 1+spread]`` — enough heterogeneity for
+#: the priced split to land mid-way when the rates put the break-even
+#: inside the spread.  A documented model constant, same standing as
+#: ``LIVE_FRACTION`` — an engine's measured census (the ``auto`` split
+#: at build time) always wins.
+HYBRID_LIVE_SPREAD = 0.5
+
+#: Share of a compacted-tier plan row the SHARED receive layout
+#: (bitpacked ridx/rok) occupies — it streams per chunk regardless of
+#: which terms the split stores, so a partial-term plan's bytes floor at
+#: this fraction of the full row (measured 0.39–0.40 on the lossless
+#: tier: 115056/288864 B on the tfxy_12 all-recompute gate engine,
+#: 2827968/7288512 B on the tfxy_16 mixed split — `make hybrid-check`).
+HYBRID_SHARED_ROW_FRACTION = 0.4
 
 
 def stream_plan_bytes_per_row(num_terms: int, pair: bool,
@@ -163,6 +183,57 @@ def stream_plan_bytes_per_row(num_terms: int, pair: bool,
     return num_terms * (4.0 + coeff_b) * LIVE_FRACTION * 1.08
 
 
+def hybrid_split_model(n_states: int, num_terms: int, pair: bool,
+                       n_devices: int, group_order: int,
+                       rates: Optional[dict],
+                       eff_tier: str) -> Optional[dict]:
+    """Offline model of the hybrid mode's per-term split (DESIGN.md §28),
+    pricing through the SAME :func:`~distributed_matvec_tpu.obs.roofline.
+    price_term_split` the engine's ``auto`` policy uses — so the planner,
+    the engine, and ``price_job`` agree on the economics.
+
+    Per-term live fractions are modeled as a linear
+    ``LIVE_FRACTION·[1±HYBRID_LIVE_SPREAD]`` spread (an engine's measured
+    census wins at build time); ``group_order`` is |G| (``--group-order``
+    — 1 for unprojected sectors, where recompute is cheapest).  None when
+    no usable rate calibration is available."""
+    if not (rates and all(rates.get(k) for k in
+                          ("flops_per_s", "gather_rows_per_s",
+                           "h2d_bytes_per_s"))):
+        return None
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from distributed_matvec_tpu.obs import roofline as _roofline
+    except ImportError:
+        return None
+    import numpy as np
+
+    T = max(int(num_terms), 1)
+    rows_share = n_states / max(n_devices, 1)
+    spread = np.linspace(1.0 - HYBRID_LIVE_SPREAD,
+                         1.0 + HYBRID_LIVE_SPREAD, T)
+    live_frac = np.clip(LIVE_FRACTION * spread, 0.02, 1.0)
+    live = live_frac * rows_share
+    ncomp = 2 if pair else 1
+    coeff_b = {"lossless": 2.0, "f32": 4.0 * ncomp,
+               "bf16": 2.0 * ncomp}[eff_tier]
+    res = _roofline.price_term_split(live, rows_share,
+                                     max(int(group_order), 1), rates,
+                                     4.0 + coeff_b, cplx=pair)
+    mask = np.asarray(res["stream_mask"], bool)
+    total_live = float(live.sum())
+    return {"stream_mask": mask,
+            "stream_terms": int(mask.sum()), "num_terms": T,
+            "stream_term_fraction": float(mask.mean()),
+            "stream_live_fraction":
+            (float(live[mask].sum()) / total_live if total_live else 1.0),
+            "stream_ms": res["stream_ms"],
+            "recompute_ms": res["recompute_ms"],
+            "live_frac": live_frac, "eff_tier": eff_tier,
+            "group_order": max(int(group_order), 1)}
+
+
 def load_rate_calibration(path: Optional[str] = None) -> Optional[dict]:
     """The measured-rates calibration sidecar ``tools/gather_bound.py``
     persists (``obs/roofline.py``) — explicit path, else the
@@ -190,7 +261,8 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
          utilization: float = DEFAULT_UTILIZATION,
          host_ram_gb: float = 64.0,
          rates: Optional[dict] = None,
-         stream_compress: str = "off") -> dict:
+         stream_compress: str = "off",
+         group_order: int = 1) -> dict:
     """The capacity report: bytes/row, max basis per device and per mesh
     for each mode, plus (optionally) measured calibration.  The streamed
     mode is additionally bounded by HOST RAM (``host_ram_gb``, per rank —
@@ -252,6 +324,15 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
                 plan_bytes_per_row_measured=round(anchor_row, 2),
                 plan_bytes_per_row_compress=mcomp)
     plan_row = plan_row_by[stream_compress]
+    # hybrid encodes at the compacted tier (compress "off" maps to
+    # lossless — a term subset cannot ride the raw layout), and its
+    # split is modeled through the shared roofline pricer
+    hyb_tier = "lossless" if stream_compress in (None, "", "off") \
+        else stream_compress
+    hyb = hybrid_split_model(int(n_states), int(num_terms), bool(pair),
+                             int(n_devices), int(group_order), rates,
+                             hyb_tier)
+    out["inputs"]["group_order"] = int(group_order)
     if rates:
         out["rates"] = {k: rates.get(k) for k in
                         ("gather_rows_per_s", "h2d_bytes_per_s",
@@ -270,6 +351,37 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
             entry["host_plan_bytes_per_row_by_compress"] = {
                 s: round(r, 2) for s, r in plan_row_by.items()}
             rows_dev = min(rows_dev, int(host_budget // plan_row))
+        elif mode == "hybrid":
+            # the hybrid plan stores the streamed term subset only: host
+            # bytes shrink by the recomputed terms' live share, floored
+            # at the shared ridx/rok receive layout's share of the row
+            # (it streams per chunk regardless of the split)
+            frac = hyb["stream_live_fraction"] if hyb else 1.0
+            row_h = plan_row_by[hyb_tier] * (
+                HYBRID_SHARED_ROW_FRACTION
+                + (1.0 - HYBRID_SHARED_ROW_FRACTION) * frac)
+            entry["host_plan_bytes_per_row"] = round(row_h, 2)
+            entry["stream_compress"] = hyb_tier
+            if hyb:
+                entry["hybrid_stream_terms"] = hyb["stream_terms"]
+                entry["hybrid_stream_term_fraction"] = round(
+                    hyb["stream_term_fraction"], 4)
+            rows_dev = min(rows_dev, int(host_budget // max(row_h, 1.0)))
+            if rates and rates.get("h2d_bytes_per_s"):
+                # priced split: the streamed share at the h2d floor plus
+                # the recomputed terms' orbit-scan flops.  NB the pure
+                # streamed row is priced at the CONFIGURED tier while
+                # hybrid always rides the compacted tier, so hybrid's
+                # est undercuts both pure tiers when the recompute
+                # credit (and, off-tier, the forced compaction) is
+                # decisive — near the per-term break-even a mixed split
+                # prices close to pure streamed, which is the honest
+                # reading of break-even economics
+                h2d_ms = rows_share * row_h \
+                    / float(rates["h2d_bytes_per_s"]) * 1e3
+                rec_ms = float(hyb["recompute_ms"][
+                    ~hyb["stream_mask"]].sum()) if hyb else 0.0
+                entry["est_apply_ms"] = round(h2d_ms + rec_ms, 3)
         if rates and rates.get("gather_rows_per_s"):
             # gather-roofline apply-time estimate per device shard at the
             # calibrated rates: ell/compact gather T0 entries/row; fused
@@ -281,7 +393,7 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
                 per = T0 if mode in ("ell", "compact") else int(num_terms)
                 entry["est_apply_ms"] = round(
                     rows_share * per / g * 1e3, 3)
-            elif rates.get("h2d_bytes_per_s"):
+            elif mode == "streamed" and rates.get("h2d_bytes_per_s"):
                 h2d_ms = rows_share * plan_row \
                     / float(rates["h2d_bytes_per_s"]) * 1e3
                 entry["est_apply_ms"] = round(h2d_ms, 3)
@@ -366,7 +478,8 @@ def price_job(spec, calibration: Optional[dict] = None,
                   bool(spec.get("pair")), float(hbm_gb),
                   max(int(spec.get("n_devices") or 1), 1),
                   vectors, max(k, 2), utilization=utilization,
-                  host_ram_gb=float(host_ram_gb), rates=calibration)
+                  host_ram_gb=float(host_ram_gb), rates=calibration,
+                  group_order=max(int(spec.get("group_order") or 1), 1))
     entry = report["modes"].get(mode)
     if entry is None:
         return {"est_apply_ms": None, "est_solve_s": None, "fits": False,
@@ -409,7 +522,7 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
     D = report["inputs"]["n_devices"]
     rec = {"target_n": n}
     options = []
-    for mode in ("ell", "compact", "streamed", "fused"):
+    for mode in ("ell", "compact", "streamed", "hybrid", "fused"):
         m = report["modes"][mode]
         need = max(1, math.ceil(n / m["max_rows_per_device"])) \
             if m["max_rows_per_device"] else None
@@ -418,7 +531,11 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
     fitting = [(mode, need) for mode, need in options
                if need is not None and need <= D]
     if fitting:
-        rec["recommended_mode"], rec["recommended_devices"] = fitting[0]
+        # unpriced preference order: hybrid only wins through the est
+        # ranking below — without rates there is no split to price, so
+        # the documented ell > compact > streamed > fused order stands
+        unpriced = [o for o in fitting if o[0] != "hybrid"] or fitting
+        rec["recommended_mode"], rec["recommended_devices"] = unpriced[0]
         pipelined_won = False
         ests = {mode: report["modes"][mode].get("est_apply_ms")
                 for mode, _need in fitting}
@@ -431,16 +548,28 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
             if best[0] == "streamed" and pipe_est is not None:
                 pipelined_won = True
                 rec["est_apply_ms_pipelined"] = pipe_est
+        hybrid_note = ""
+        if rec["recommended_mode"] == "hybrid":
+            hm = report["modes"]["hybrid"]
+            rec["recommended_hybrid_split"] = "auto"
+            if "hybrid_stream_term_fraction" in hm:
+                hybrid_note = (
+                    f" (priced split: ~{hm['hybrid_stream_terms']}"
+                    f"/{report['inputs']['num_terms']} terms streamed — "
+                    "run with hybrid_split=auto / DMT_HYBRID=auto)")
         rec["note"] = (f"{rec['recommended_mode']} fits {n:,} rows on "
                        f"{rec['recommended_devices']} of {D} device(s)"
                        + (" (priced pipelined: run with "
                           "pipeline_depth=auto / DMT_PIPELINE=auto)"
-                          if pipelined_won else ""))
+                          if pipelined_won else "") + hybrid_note)
         if pipelined_won:
             rec["recommended_pipeline"] = "auto"
     else:
+        # minimal-shard fallback: ties break AWAY from hybrid (fused
+        # matches its device bytes without the host-plan dependency)
         mode, need = min((o for o in options if o[1] is not None),
-                         key=lambda o: o[1], default=(None, None))
+                         key=lambda o: (o[1], o[0] == "hybrid"),
+                         default=(None, None))
         rec["recommended_mode"], rec["recommended_devices"] = mode, need
         rec["note"] = (f"no mode fits {n:,} rows on {D} device(s); "
                        f"{mode} needs >= {need} shards")
@@ -470,7 +599,7 @@ def print_report(report: dict, rec: dict) -> None:
     print(f"  {'mode':<9} {'struct B/row':>13} {'total B/row':>12} "
           f"{'max rows/device':>16} {'max basis (mesh)':>17}"
           + (f" {'est ms/apply':>13}" if est_col else "") + "  fits N?")
-    for mode in ("ell", "compact", "streamed", "fused"):
+    for mode in ("ell", "compact", "streamed", "hybrid", "fused"):
         m = report["modes"][mode]
         note = (f"  (+{m['host_plan_bytes_per_row']:.0f} B/row host plan, "
                 f"stream_compress={m['stream_compress']})"
@@ -492,7 +621,36 @@ def print_report(report: dict, rec: dict) -> None:
                   f"{m['est_apply_ms_pipelined']:,.1f} ms/apply "
                   f"(wall minus min(compute, exchange+stream)"
                   f"·(1-1/n))")
+        if "hybrid_stream_term_fraction" in m:
+            print(f"            priced split (|G|="
+                  f"{ins.get('group_order', 1)}): "
+                  f"{m['hybrid_stream_terms']}/{ins['num_terms']} terms "
+                  f"streamed ({m['hybrid_stream_term_fraction']:.0%}), "
+                  "rest recomputed on device")
     print(f"  recommendation: {rec['note']}")
+
+
+def print_hybrid_terms(report: dict, hyb: Optional[dict]) -> None:
+    """The ``--hybrid`` per-term cost table: each modeled term's stream
+    vs recompute price at the calibrated rates, and which side the
+    priced split puts it on (DESIGN.md §28)."""
+    if not hyb:
+        print("  hybrid term table: no usable rate calibration "
+              "(pass --calibration or run tools/gather_bound.py)")
+        return
+    print(f"  hybrid per-term costs (|G|={hyb['group_order']}, "
+          f"tier={hyb['eff_tier']}, modeled live spread "
+          f"{LIVE_FRACTION}·[1±{HYBRID_LIVE_SPREAD}]):")
+    print(f"  {'term':>6} {'live frac':>10} {'stream ms':>11} "
+          f"{'recompute ms':>13}  tier")
+    for t in range(hyb["num_terms"]):
+        side = "stream" if hyb["stream_mask"][t] else "recompute"
+        print(f"  {t:>6} {hyb['live_frac'][t]:>10.3f} "
+              f"{hyb['stream_ms'][t]:>11.3f} "
+              f"{hyb['recompute_ms'][t]:>13.3f}  {side}")
+    print(f"  -> {hyb['stream_terms']}/{hyb['num_terms']} terms streamed "
+          f"({hyb['stream_term_fraction']:.0%}; "
+          f"{hyb['stream_live_fraction']:.0%} of the live entries)")
 
 
 def main(argv=None) -> int:
@@ -532,6 +690,14 @@ def main(argv=None) -> int:
                          "plan (and its est ms/apply) at; every "
                          "setting's bytes/row is reported alongside "
                          "(default: DMT_STREAM_COMPRESS or off)")
+    ap.add_argument("--group-order", type=int, default=1, metavar="G",
+                    help="symmetry group order |G| for the hybrid "
+                         "recompute pricing (default 1 — unprojected "
+                         "sectors, the cheap-orbit regime)")
+    ap.add_argument("--hybrid", action="store_true",
+                    help="print the per-term recompute-vs-stream cost "
+                         "table the hybrid split is priced from "
+                         "(DESIGN.md §28; needs a rate calibration)")
     ap.add_argument("--calibration", default=None, metavar="PATH",
                     help="rate-calibration JSON from tools/gather_bound.py "
                          "(default: the content-addressed sidecar under "
@@ -583,18 +749,26 @@ def main(argv=None) -> int:
         pair = args.pair
         n_devices = args.n_devices
 
+    rates = load_rate_calibration(args.calibration)
     report = plan(n_states, num_terms, T0, pair, args.hbm_gb, n_devices,
                   args.vectors, args.vec_width, measured=measured,
                   utilization=args.utilization,
                   host_ram_gb=args.host_ram_gb,
-                  rates=load_rate_calibration(args.calibration),
-                  stream_compress=args.stream_compress)
+                  rates=rates,
+                  stream_compress=args.stream_compress,
+                  group_order=args.group_order)
     rec = recommend(report, int(args.target_n) if args.target_n else None)
     if args.json:
         print(json.dumps({"report": report, "recommendation": rec},
                          indent=1, sort_keys=True))
     else:
         print_report(report, rec)
+        if args.hybrid:
+            hyb_tier = "lossless" if args.stream_compress == "off" \
+                else args.stream_compress
+            print_hybrid_terms(report, hybrid_split_model(
+                n_states, num_terms, pair, n_devices, args.group_order,
+                rates, hyb_tier))
     return 0
 
 
