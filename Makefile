@@ -14,7 +14,7 @@ HEALTH_THRESHOLD ?= 0.02
 .PHONY: check check-fast check-solve smoke dryrun bench warm-cache \
 	obs-check health-check mem-check stream-check fault-check \
 	roofline-check compress-check trace-check pipeline-check \
-	hybrid-check serve-check elastic-check clean
+	hybrid-check serve-check elastic-check dynamics-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -28,6 +28,7 @@ check:
 	$(MAKE) hybrid-check
 	$(MAKE) trace-check
 	$(MAKE) serve-check
+	$(MAKE) dynamics-check
 	$(MAKE) fault-check
 	$(MAKE) elastic-check
 
@@ -167,6 +168,21 @@ trace-check:
 # throughput/latency regression.  Deterministic seeds, ~90 s on CPU.
 serve-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/serve_check.py
+
+# Dynamics gate (tools/dynamics_check.py, DESIGN.md §29): KPM moments
+# on a streamed chain_12 engine match the dense matrix's own Chebyshev
+# recurrence at 1e-12 with the plan provably built ONCE (engine_init
+# counted once across bounds + every moment), the Jackson-kernel DOS
+# matches the exact spectrum through the SAME kernel within the
+# stochastic tolerance, exp(-iHt) matches dense expm at rtol 1e-10
+# with unitarity drift < 1e-12/step, the max_basis_size-capped
+# thick-restart block Lanczos reaches the full-memory E0 at rtol
+# 1e-12 with every restart inside the cap, a SIGTERMed mid-trajectory
+# apps/dynamics.py run exits 75 and resumes bit-consistently, and the
+# kpm_moments_per_s / evolve_steps_per_s trend gate passes then FIRES
+# on a synthetic 10x regression.  Deterministic, ~25 s on the CPU rig.
+dynamics-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/dynamics_check.py
 
 # Chaos gate (tools/fault_check.py): the ROADMAP's resumed-run
 # bit-consistency acceptance as a repeatable gate — kill a 2-device solve

@@ -54,7 +54,15 @@ def _main(argv=None):
                "to resume; apps/solve_service.py uses the same code when "
                "draining), 76 stalled (a wedged peer rank tripped the "
                "heartbeat watchdog).  A supervisor should retry 75/76 "
-               "and treat other nonzero codes as permanent.")
+               "and treat other nonzero codes as permanent.  "
+               "Solver kinds served over the same engines: eigs "
+               "(lowest-k eigenpairs — this app, and the JobSpec "
+               "default for --submit), kpm (Chebyshev/KPM spectral "
+               "densities) and evolve (Krylov exp(-iHt) time "
+               "evolution) — the dynamics kinds run via "
+               "apps/dynamics.py (same 75/76 contract) or a JobSpec "
+               "with solver=kpm|evolve through the solve service "
+               "(DESIGN.md §29).")
     ap.add_argument("input", help="YAML config (data/*.yaml schema)")
     ap.add_argument("-o", "--output", default=None,
                     help="output HDF5 (default: <input>.h5); also the "
@@ -464,32 +472,16 @@ def _main(argv=None):
         # Every observable engine shares H's mesh and hash layout (pure
         # functions of the basis + device count), so the hashed ψ is
         # directly consumable — no block-order psi, no layout
-        # materialization, no global array at any point.
+        # materialization, no global array at any point.  The binding +
+        # state-form algebra lives in models/observables (shared with
+        # the dynamics solvers, DESIGN.md §29).
         from distributed_matvec_tpu.io.hdf5 import save_observables
-        from distributed_matvec_tpu.parallel.distributed import (
-            DistributedEngine)
-        import jax.numpy as jnp
-
-        psi_h = evecs_hashed[0]
-
-        def expectation_hashed(obs):
-            oeng = DistributedEngine.from_shards(
-                obs, args.shards, mesh=eng.mesh, mode="fused")
-            if is_pair or not oeng.pair:
-                # same form either way, or pair ψ [D, M, 2] into a
-                # REAL-sector engine: the trailing (re, im) axis is exactly
-                # a 2-column real batch, and the summed batch dot is
-                # Re†O·Re + Im†O·Im — the full ψ†Oψ for real Hermitian O
-                # (cross terms cancel)
-                xh = psi_h
-            else:
-                # real ψ into a complex-sector (pair) engine: zero imag
-                xh = jnp.stack([psi_h, jnp.zeros_like(psi_h)], axis=-1)
-            return float(np.real(complex(oeng.dot(xh, oeng.matvec(xh)))))
+        from distributed_matvec_tpu.models.observables import (
+            expectations as _expectations)
 
         with timer.scope("observables"):
-            values = [(obs.name or f"observable_{k}", expectation_hashed(obs))
-                      for k, obs in enumerate(cfg.observables)]
+            values = _expectations(cfg.observables, eng, evecs_hashed[0],
+                                   shards_path=args.shards)
         if rank0:
             for name, val in save_observables(out, values).items():
                 print(f"  <{name}> = {val:.12f}")

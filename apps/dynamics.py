@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Dynamics: YAML model in → KPM spectral density or exp(-iHt) trajectory.
+
+The dynamics-family driver beside ``apps/diagonalize.py`` (DESIGN.md
+§29): the same engine stack (ell / streamed / hybrid, ``--devices``
+meshes), the same exit-code contract, but the solve is Chebyshev/KPM
+moments + a kernel-reconstructed density of states (``--solver kpm``)
+or Krylov time evolution with drift telemetry and optional per-step
+observable trajectories (``--solver evolve``).
+
+Usage:
+    python apps/dynamics.py model.yaml --solver kpm --moments 256 -o dos.h5
+    python apps/dynamics.py model.yaml --solver evolve --t-final 5 \
+        --observables --checkpoint traj.h5
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+from distributed_matvec_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def main(argv=None):
+    from distributed_matvec_tpu.obs import trace as _trace
+
+    with _trace.span("dynamics", kind="run"):
+        return _main(argv)
+
+
+def _main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit codes: 0 solved, 2 bad config/arguments, "
+               "75 preempted (SIGTERM/SIGINT latched; with --checkpoint "
+               "the trajectory/moment state was written at the last "
+               "step boundary — relaunch the SAME argv to resume, "
+               "bit-consistent with an uninterrupted run), 76 stalled "
+               "(heartbeat watchdog).  A supervisor should retry 75/76 "
+               "and treat other nonzero codes as permanent — the same "
+               "contract as apps/diagonalize.py.")
+    ap.add_argument("input", help="YAML config (data/*.yaml schema)")
+    ap.add_argument("--solver", choices=("kpm", "evolve"), default="kpm",
+                    help="dynamics solver: Chebyshev/KPM spectral "
+                         "density (kpm) or Krylov exp(-iHt) time "
+                         "evolution (evolve); eigenpair solves live in "
+                         "apps/diagonalize.py")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output HDF5 (default: <input>.dyn.h5)")
+    ap.add_argument("--mode", choices=("ell", "compact", "streamed",
+                                       "fused", "hybrid"),
+                    default="streamed",
+                    help="engine mode (default streamed: the plan is "
+                         "resolved once and re-streamed per apply — the "
+                         "regime repeated-matvec dynamics amortizes "
+                         "best)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="shard over an n-device mesh (0 = one device)")
+    # -- kpm ---------------------------------------------------------------
+    ap.add_argument("--moments", type=int, default=256,
+                    help="kpm: Chebyshev moment count (energy "
+                         "resolution ~ pi*spectral_halfwidth/moments)")
+    ap.add_argument("--vectors", type=int, default=4,
+                    help="kpm: stochastic-trace random vectors (error "
+                         "~ 1/sqrt(n_states*vectors))")
+    ap.add_argument("--kernel", choices=("jackson", "lorentz", "none"),
+                    default="jackson", help="kpm: damping kernel")
+    ap.add_argument("--points", type=int, default=512,
+                    help="kpm: energy-grid points for the DOS")
+    ap.add_argument("--bounds-iters", type=int, default=64,
+                    help="kpm: Lanczos iterations for the spectral "
+                         "bracket")
+    # -- evolve ------------------------------------------------------------
+    ap.add_argument("--t-final", type=float, default=1.0,
+                    help="evolve: trajectory length")
+    ap.add_argument("--dt0", type=float, default=None,
+                    help="evolve: initial adaptive step (default "
+                         "t_final/16)")
+    ap.add_argument("--krylov-dim", type=int, default=24,
+                    help="evolve: per-step Krylov dimension")
+    ap.add_argument("--tol", type=float, default=1e-12,
+                    help="evolve: local-error budget per unit time")
+    ap.add_argument("--observables", action="store_true",
+                    help="evolve: record <psi|O|psi> trajectories for "
+                         "the YAML observables (bound fused-mode "
+                         "engines sharing the basis artifacts)")
+    # -- shared ------------------------------------------------------------
+    ap.add_argument("--seed", type=int, default=42,
+                    help="start-state / random-vector seed")
+    ap.add_argument("--checkpoint", default=None, metavar="CKPT_H5",
+                    help="mid-run checkpoint/resume file: the solver "
+                         "state is written at step boundaries and on "
+                         "preemption; a rerun with the same argv "
+                         "resumes bit-consistently")
+    ap.add_argument("--checkpoint-every", type=int, default=16,
+                    help="checkpoint cadence in solver steps")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="telemetry run directory (DMT_OBS_DIR)")
+    ap.add_argument("--job-id", default=None, metavar="ID",
+                    help="job-namespacing id (DMT_JOB_ID)")
+    args = ap.parse_args(argv)
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.models.yaml_io import load_config_from_yaml
+    from distributed_matvec_tpu.utils.config import update_config
+
+    if args.obs_dir:
+        update_config(obs_dir=args.obs_dir)
+    if args.job_id:
+        os.environ["DMT_JOB_ID"] = args.job_id
+        update_config(job_id=args.job_id)
+
+    import signal as _signal
+
+    from distributed_matvec_tpu.utils import preempt as _preempt
+    from distributed_matvec_tpu.utils.preempt import (EXIT_PREEMPTED,
+                                                      Preempted)
+    _preempt.ensure_installed(signals=(_signal.SIGTERM, _signal.SIGINT))
+
+    out = args.output or os.path.splitext(args.input)[0] + ".dyn.h5"
+    obs.emit("run_start", app="dynamics", input=args.input, output=out,
+             solver=args.solver, mode=args.mode, devices=args.devices)
+
+    cfg = load_config_from_yaml(args.input, hamiltonian=True,
+                                observables=args.observables)
+    if cfg.hamiltonian is None:
+        print("config has no hamiltonian section", file=sys.stderr)
+        return 2
+    if not cfg.hamiltonian.effective_is_real:
+        from distributed_matvec_tpu.parallel.engine import use_pair_complex
+        if use_pair_complex():
+            print("dynamics solvers do not support pair-form complex "
+                  "sectors (no J-aware recurrence) — run the sector "
+                  "native-c128 on CPU", file=sys.stderr)
+            return 2
+
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+
+    eng = DistributedEngine(cfg.hamiltonian,
+                            n_devices=args.devices or 1, mode=args.mode)
+    n = eng.n_states
+    print(f"basis: N={n} states, engine mode={args.mode}")
+
+    t0 = time.perf_counter()
+    try:
+        if args.solver == "kpm":
+            from distributed_matvec_tpu.solve import (kpm_moments,
+                                                      reconstruct_dos)
+            res = kpm_moments(
+                eng.matvec, n_moments=args.moments,
+                n_vectors=args.vectors, seed=args.seed,
+                bounds_iters=args.bounds_iters,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every)
+            energies, rho = reconstruct_dos(
+                res.moments, res.scale, npoints=args.points,
+                kernel=args.kernel)
+            dt = time.perf_counter() - t0
+            print(f"kpm: {args.moments} moments ({res.num_applies} "
+                  f"applies) in {dt:.2f}s "
+                  f"({res.steady_moments_per_s:.1f} moments/s steady)")
+            print(f"  spectral bracket [{res.bounds[0]:.6f}, "
+                  f"{res.bounds[1]:.6f}]")
+            payload = {"moments": res.moments,
+                       "moment_stderr": res.moment_stderr,
+                       "energies": energies, "dos": rho,
+                       "bounds": np.asarray(res.bounds),
+                       "scale": np.asarray(res.scale)}
+        else:
+            from distributed_matvec_tpu.solve import krylov_evolve
+            bound = []
+            if args.observables and cfg.observables:
+                from distributed_matvec_tpu.models.observables import (
+                    bind_observables)
+                bound = bind_observables(cfg.observables, eng)
+            res = krylov_evolve(
+                eng.matvec, t_final=args.t_final, dt0=args.dt0,
+                krylov_dim=args.krylov_dim, tol=args.tol,
+                seed=args.seed, observables=bound,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every)
+            dt = time.perf_counter() - t0
+            if res.resumed_from:
+                print(f"solver: resumed from {res.resumed_from} "
+                      "checkpointed steps")
+            print(f"evolve: t={res.times[-1]:.6f} in {res.num_steps} "
+                  f"steps / {res.num_applies} applies in {dt:.2f}s "
+                  f"({res.steady_steps_per_s:.2f} steps/s steady)")
+            print(f"  norm drift {res.norm_drift:.3e}, energy drift "
+                  f"{res.energy_drift:.3e}")
+            payload = {"times": res.times, "energies": res.energies,
+                       "norm_drift": np.float64(res.norm_drift),
+                       "energy_drift": np.float64(res.energy_drift)}
+            for name, series in (res.observables or {}).items():
+                payload[f"obs_{name}_t"] = np.asarray(
+                    [t for t, _ in series])
+                payload[f"obs_{name}"] = np.asarray(
+                    [v for _, v in series])
+                print(f"  <{name}>(t={series[-1][0]:.4f}) = "
+                      f"{series[-1][1]:.12f}")
+    except Preempted as e:
+        print(f"preempted: {e}", file=sys.stderr)
+        obs.emit("run_preempted", app="dynamics", solver=e.solver,
+                 iters=int(e.iters), checkpoint=e.checkpoint_path or "",
+                 exit_code=EXIT_PREEMPTED)
+        obs.emit("metrics_snapshot", metrics=obs.snapshot())
+        obs.flush()
+        return EXIT_PREEMPTED
+
+    import h5py
+
+    with h5py.File(out, "a") as f:
+        grp = f.require_group(args.solver)
+        for key, val in payload.items():
+            if key in grp:
+                del grp[key]
+            grp.create_dataset(key, data=val)
+    print(f"wrote /{args.solver} -> {out}")
+    obs.emit("dynamics_result", solver=args.solver,
+             **{k: (float(v) if np.ndim(v) == 0 else int(np.size(v)))
+                for k, v in payload.items()})
+    obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -509,6 +509,138 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     return out
 
 
+def _bench_kpm(name, *args, **kwargs):
+    with obs.span(f"bench:{name}", kind="config", config=name):
+        return _bench_kpm_impl(name, *args, **kwargs)
+
+
+def _dense_from_engine(op, n, block=64):
+    """Dense H assembled by batched identity applies through a LOCAL
+    ell engine — the reference spectrum for the bench's broadening-aware
+    DOS error (the independent dense_ref algebra stays tests-only; a
+    trend metric needs a spectrum, not a proof)."""
+    import jax.numpy as jnp
+
+    from distributed_matvec_tpu.parallel.engine import LocalEngine
+
+    leng = LocalEngine(op)
+    H = np.empty((n, n))
+    for lo in range(0, n, block):
+        hi = min(lo + block, n)
+        I = np.zeros((n, hi - lo))
+        I[np.arange(lo, hi), np.arange(hi - lo)] = 1.0
+        H[:, lo:hi] = np.asarray(leng.matvec(jnp.asarray(I))).real
+    return (H + H.T) / 2
+
+
+def _bench_kpm_impl(name, basis_args, n_moments=256, n_vectors=4,
+                    n_devices=1, mode="streamed", dense_max=4096,
+                    edges=None):
+    """KPM spectral-density leg (DESIGN.md §29): one streamed engine
+    whose plan is built ONCE (``kpm_engine_init_s``) and re-streamed
+    across every moment apply; records the trend-gated
+    ``kpm_moments_per_s`` (steady recurrence rate, compile excluded),
+    the per-block-apply wall ``kpm_apply_ms``, and — when the sector is
+    small enough to diagonalize — ``kpm_dos_rel_err``: the L2 distance
+    between the stochastic-trace DOS and the exact spectrum pushed
+    through the SAME Jackson kernel (broadening-aware: both sides carry
+    the identical kernel, so the residual is stochastic-trace noise
+    ~ sqrt(2/(N R)) plus engine error, not resolution mismatch)."""
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.solve import kpm_moments, reconstruct_dos
+    from distributed_matvec_tpu.utils.artifacts import make_or_restore_basis
+
+    n_sites = basis_args["number_spins"]
+    obs.emit("bench_config_start", config=name)
+    _progress(f"{name}: kpm bench, building basis")
+    op = _build_op(basis_args, n_sites, edges)
+    make_or_restore_basis(op.basis)
+    n = op.basis.number_states
+    t0 = time.perf_counter()
+    eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+    init_s = time.perf_counter() - t0
+    _progress(f"{name}: {n_moments} moments over {n_vectors} vectors "
+              f"({mode} engine)")
+    res = kpm_moments(eng.matvec, n_moments=n_moments,
+                      n_vectors=n_vectors, seed=11)
+    steady_applies = max(n_moments // 2 - 1, 1)
+    out = {
+        "config": name, "n_states": n,
+        "kpm_n_moments": int(n_moments),
+        "kpm_n_vectors": int(n_vectors),
+        "kpm_engine_init_s": round(init_s, 3),
+        "kpm_bounds": [round(res.bounds[0], 6), round(res.bounds[1], 6)],
+        "kpm_moments_per_s": round(res.steady_moments_per_s, 3),
+        "kpm_apply_ms": round(
+            1e3 * res.steady_seconds / steady_applies, 3),
+        "kpm_num_applies": int(res.num_applies),
+    }
+    if n <= dense_max:
+        from distributed_matvec_tpu.solve import exact_moments
+
+        _progress(f"{name}: dense reference spectrum (N={n})")
+        w = np.linalg.eigvalsh(_dense_from_engine(op, n))
+        mu_exact = exact_moments(w, res.scale, n_moments)
+        _, rho = reconstruct_dos(res.moments, res.scale, npoints=512)
+        _, rho_ref = reconstruct_dos(mu_exact, res.scale, npoints=512)
+        out["kpm_dos_rel_err"] = float(
+            np.linalg.norm(rho - rho_ref) / np.linalg.norm(rho_ref))
+    _progress(f"{name}: {out['kpm_moments_per_s']} moments/s, "
+              f"rel err {out.get('kpm_dos_rel_err', 'n/a')}")
+    obs.emit("bench_result", **out)
+    return out
+
+
+def _bench_evolve(name, *args, **kwargs):
+    with obs.span(f"bench:{name}", kind="config", config=name):
+        return _bench_evolve_impl(name, *args, **kwargs)
+
+
+def _bench_evolve_impl(name, basis_args, t_final=2.0, krylov_dim=16,
+                       tol=1e-12, n_devices=1, mode="streamed",
+                       edges=None):
+    """Krylov time-evolution leg (DESIGN.md §29): a seeded random state
+    evolved to ``t_final`` on one streamed engine (plan built once,
+    every Krylov vector ONE 2-column block apply).  Records the
+    trend-gated ``evolve_steps_per_s`` (steady accepted-step rate)
+    plus the unitarity/energy drift error metrics — the propagator is
+    exactly unitary and commutes with H, so both drifts are pure
+    roundoff and growth is a numerics regression."""
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    from distributed_matvec_tpu.solve import krylov_evolve
+    from distributed_matvec_tpu.utils.artifacts import make_or_restore_basis
+
+    n_sites = basis_args["number_spins"]
+    obs.emit("bench_config_start", config=name)
+    _progress(f"{name}: evolve bench, building basis")
+    op = _build_op(basis_args, n_sites, edges)
+    make_or_restore_basis(op.basis)
+    n = op.basis.number_states
+    t0 = time.perf_counter()
+    eng = DistributedEngine(op, n_devices=n_devices, mode=mode)
+    init_s = time.perf_counter() - t0
+    _progress(f"{name}: exp(-iHt) to t={t_final} ({mode} engine, "
+              f"m={krylov_dim})")
+    res = krylov_evolve(eng.matvec, t_final=t_final,
+                        krylov_dim=krylov_dim, tol=tol, seed=13)
+    out = {
+        "config": name, "n_states": n,
+        "evolve_t_final": float(t_final),
+        "evolve_engine_init_s": round(init_s, 3),
+        "evolve_steps": int(res.num_steps),
+        "evolve_steps_per_s": round(res.steady_steps_per_s, 3),
+        "evolve_norm_drift": float(res.norm_drift),
+        "evolve_energy_drift": float(res.energy_drift),
+        "evolve_num_applies": int(res.num_applies),
+        "evolve_rejects": int(res.num_rejects),
+    }
+    _progress(f"{name}: {res.num_steps} steps, "
+              f"{out['evolve_steps_per_s']} steps/s, norm drift "
+              f"{out['evolve_norm_drift']:.2e}")
+    obs.emit("bench_result", **out)
+    return out
+
+
 def _bench_serve(name, *args, **kwargs):
     with obs.span(f"bench:{name}", kind="config", config=name):
         return _bench_serve_impl(name, *args, **kwargs)
@@ -633,6 +765,9 @@ CHAIN_32_SYMM = dict(number_spins=32, hamming_weight=16, spin_inversion=1,
 CHAIN_24_SYMM = dict(number_spins=24, hamming_weight=12, spin_inversion=1,
                      symmetries=[([*range(1, 24), 0], 0),
                                  ([*reversed(range(24))], 0)])
+CHAIN_20_SYMM = dict(number_spins=20, hamming_weight=10, spin_inversion=1,
+                     symmetries=[([*range(1, 20), 0], 0),
+                                 ([*reversed(range(20))], 0)])
 CHAIN_16_SYMM = dict(number_spins=16, hamming_weight=8, spin_inversion=1,
                      symmetries=[([*range(1, 16), 0], 0),
                                  ([*reversed(range(16))], 0)])
@@ -785,6 +920,22 @@ def _main():
                 model="tfxy", hybrid_split="pairs")
         except Exception as e:
             detail["stream_chain_16_field"] = {"error": repr(e)}
+        # dynamics smoke legs (DESIGN.md §29): small sectors so the
+        # 3x obs-check smoke loop stays cheap; the full-size
+        # kpm_chain_20_symm / evolve_chain_16 legs run in the
+        # cpu_fallback and full matrices
+        try:
+            detail["kpm_chain_16_symm"] = _bench_kpm(
+                "kpm_chain_16_symm", CHAIN_16_SYMM, n_moments=96,
+                n_vectors=2)
+        except Exception as e:
+            detail["kpm_chain_16_symm"] = {"error": repr(e)}
+        try:
+            detail["evolve_chain_12"] = _bench_evolve(
+                "evolve_chain_12",
+                dict(number_spins=12, hamming_weight=6), t_final=1.0)
+        except Exception as e:
+            detail["evolve_chain_12"] = {"error": repr(e)}
     elif args.cpu_fallback:
         # Dead-chip round: run every config that is CPU-feasible (same
         # config keys as the recorded full run, minus chain_32_symm whose
@@ -825,6 +976,18 @@ def _main():
                 model="tfxy", hybrid_split="pairs")
         except Exception as e:
             detail["stream_chain_16_field"] = {"error": repr(e)}
+        try:
+            detail["kpm_chain_20_symm"] = _bench_kpm(
+                "kpm_chain_20_symm", CHAIN_20_SYMM, n_moments=256,
+                n_vectors=4)
+        except Exception as e:
+            detail["kpm_chain_20_symm"] = {"error": repr(e)}
+        try:
+            detail["evolve_chain_16"] = _bench_evolve(
+                "evolve_chain_16",
+                dict(number_spins=16, hamming_weight=8), t_final=2.0)
+        except Exception as e:
+            detail["evolve_chain_16"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_24_symm", CHAIN_24_SYMM,
@@ -873,6 +1036,18 @@ def _main():
                 model="tfxy", hybrid_split="pairs")
         except Exception as e:
             detail["stream_chain_16_field"] = {"error": repr(e)}
+        try:
+            detail["kpm_chain_20_symm"] = _bench_kpm(
+                "kpm_chain_20_symm", CHAIN_20_SYMM, n_moments=256,
+                n_vectors=4)
+        except Exception as e:
+            detail["kpm_chain_20_symm"] = {"error": repr(e)}
+        try:
+            detail["evolve_chain_16"] = _bench_evolve(
+                "evolve_chain_16",
+                dict(number_spins=16, hamming_weight=8), t_final=2.0)
+        except Exception as e:
+            detail["evolve_chain_16"] = {"error": repr(e)}
         try:
             main_cfg = _bench_config(
                 "heisenberg_chain_32_symm", CHAIN_32_SYMM,

@@ -1,0 +1,159 @@
+"""Bound observables — ``<psi|O|psi>`` against engine-layout states.
+
+The reference's object model carries observables next to the
+Hamiltonian (``loadConfigFromYaml(file, hamiltonian, observables)``,
+PAPER.md §L2); here every observable becomes its own ENGINE sharing the
+solve engine's basis artifacts: the basis/layout are pure functions of
+the (basis, device count) pair, so an observable engine built on the
+solve engine's mesh with the solve engine's layout consumes converged
+or evolved states DIRECTLY in their hashed form — no re-enumeration,
+no global array, no shuffle.  Observable engines default to FUSED mode:
+no structure build (an ELL pack costs minutes at scale and would be
+paid per observable), device-speed apply — one apply + one dot per
+expectation value.
+
+State forms handled (the same algebra ``apps/diagonalize.py`` shipped,
+factored here so the dynamics solvers and the service share it):
+
+* real state, real-sector O — direct;
+* COMPLEX state, real-sector O — the 2-column real block
+  ``[Re psi, Im psi]``: for real Hermitian O the cross terms cancel
+  (``Re†O·Im = Im†O·Re``), so the summed batched dot
+  ``Re†O·Re + Im†O·Im`` IS the full ``psi†O·psi`` — one multi-RHS
+  apply, no complex arithmetic on device;
+* complex-sector (native c128) O — the state promotes to complex;
+* pair-mode O with a pair-form state — passed through (the engine's
+  ``dot`` computes the complex inner product from the (re, im) parts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["BoundObservable", "bind_observables", "expectation_value",
+           "expectations"]
+
+
+def _is_distributed(eng) -> bool:
+    return hasattr(eng, "from_hashed")
+
+
+def _complex_native(eng) -> bool:
+    """Whether the engine consumes complex states directly (a
+    complex-sector c128 engine) rather than via the 2-column real
+    block.  The operator's ``effective_is_real`` is the authoritative
+    answer; the engine dtype is the fallback for wrapped engines."""
+    if bool(getattr(eng, "pair", False)):
+        return False
+    op = getattr(eng, "operator", None)
+    if op is not None and hasattr(op, "effective_is_real"):
+        return not op.effective_is_real
+    dt = getattr(eng, "_dtype", None)
+    return dt is not None and np.issubdtype(np.dtype(dt),
+                                            np.complexfloating)
+
+
+def expectation_value(obs_engine, psi) -> float:
+    """``Re <psi|O|psi>`` for a state in ``obs_engine``'s layout.
+
+    ``psi`` may be real, complex, or (for pair engines) (re, im)-pair
+    form; expectation values of Hermitian observables are real, so the
+    real part is returned (the imaginary residue is pure roundoff).
+    The state is consumed as-is — callers own normalization.
+    """
+    import jax.numpy as jnp
+
+    psi = jnp.asarray(psi)
+    pair = bool(getattr(obs_engine, "pair", False))
+    if _is_distributed(obs_engine):
+        if pair:
+            # a real/complex state into a pair engine: (re, im) as the
+            # trailing axis; a pair-form state passes through
+            if psi.ndim >= 3 and psi.shape[-1] == 2 \
+                    and not jnp.iscomplexobj(psi):
+                xh = psi
+            elif jnp.iscomplexobj(psi):
+                xh = jnp.stack([jnp.real(psi), jnp.imag(psi)], axis=-1)
+            else:
+                xh = jnp.stack([psi, jnp.zeros_like(psi)], axis=-1)
+        elif jnp.iscomplexobj(psi) and not _complex_native(obs_engine):
+            # the 2-column real block: summed batched dot =
+            # Re†O·Re + Im†O·Im = psi†O·psi for real Hermitian O
+            xh = jnp.stack([jnp.real(psi), jnp.imag(psi)], axis=-1)
+        else:
+            xh = psi
+        return float(np.real(complex(
+            obs_engine.dot(xh, obs_engine.matvec(xh)))))
+    # local engine
+    if jnp.iscomplexobj(psi) and not _complex_native(obs_engine):
+        x = jnp.stack([jnp.real(psi), jnp.imag(psi)], axis=-1)
+        y = obs_engine.matvec(x)
+        return float(jnp.real(jnp.sum(x * y)))
+    y = obs_engine.matvec(psi)
+    return float(np.real(complex(jnp.vdot(psi, y))))
+
+
+@dataclass
+class BoundObservable:
+    """One observable bound to a solve engine's basis artifacts."""
+
+    name: str
+    engine: object          # fused-mode engine sharing mesh/layout
+
+    def expectation(self, psi) -> float:
+        return expectation_value(self.engine, psi)
+
+    def matvec(self, x):
+        """O applied in the shared layout — the handle
+        ``solve.kpm.kpm_spectral_function`` consumes."""
+        return self.engine.matvec(x)
+
+
+def bind_observables(operators: Sequence, engine, mode: str = "fused",
+                     shards_path: Optional[str] = None
+                     ) -> List[BoundObservable]:
+    """Build one bound engine per observable operator, sharing
+    ``engine``'s mesh and hash layout (distributed) or basis (local).
+
+    ``shards_path`` routes a shard-native solve's observables through
+    the SAME shard file — the basis is still never built globally.
+    Each bound engine is fused-mode by default: kernel tables only, no
+    structure resolution, so binding k observables costs k table
+    uploads, not k plan builds.
+    """
+    out = []
+    for i, op in enumerate(operators):
+        name = getattr(op, "name", None) or f"observable_{i}"
+        if _is_distributed(engine):
+            from ..parallel.distributed import DistributedEngine
+            if shards_path:
+                oeng = DistributedEngine.from_shards(
+                    op, shards_path, mesh=engine.mesh, mode=mode)
+                # share an ALREADY-materialized layout; a shard-native
+                # solve that never built one stays lazy (the whole
+                # point of --shards is never materializing the global
+                # state array)
+                lay = getattr(engine, "layout", None)
+                if lay is not None:
+                    oeng.layout = lay
+            else:
+                oeng = DistributedEngine(op, mesh=engine.mesh, mode=mode,
+                                         layout=engine.layout)
+        else:
+            from ..parallel.engine import LocalEngine
+            oeng = LocalEngine(op, mode=mode)
+        out.append(BoundObservable(name=name, engine=oeng))
+    return out
+
+
+def expectations(operators: Sequence, engine, psi, mode: str = "fused",
+                 shards_path: Optional[str] = None
+                 ) -> List[Tuple[str, float]]:
+    """``[(name, <psi|O|psi>), ...]`` for every operator — bind + apply
+    in one call (the ``apps/diagonalize.py --observables`` epilogue)."""
+    return [(b.name, b.expectation(psi))
+            for b in bind_observables(operators, engine, mode=mode,
+                                      shards_path=shards_path)]

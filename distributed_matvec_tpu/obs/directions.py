@@ -41,6 +41,12 @@ __all__ = ["HIGHER_IS_BETTER_TAGS", "is_higher_better"]
 HIGHER_IS_BETTER_TAGS = (
     "iters_per_s", "speedup", "_rate", "hit_rate",
     "compress_ratio", "overlap_fraction", "solves_per_min",
+    # dynamics throughputs (DESIGN.md §29): Chebyshev moments and
+    # accepted evolution steps per second — rates, so shrinkage is the
+    # regression; the paired error metrics (kpm_dos_rel_err,
+    # evolve_norm_drift, evolve_energy_drift) deliberately fall through
+    # to the cost-like default (error growth is the regression)
+    "moments_per_s", "steps_per_s",
 )
 
 

@@ -4068,8 +4068,12 @@ class DistributedEngine:
             raise NotImplementedError(
                 f"{self.mode} engines cannot be traced into an outer jitted "
                 "program (the plan lives in host RAM and streams per "
-                "apply); use solve.lanczos_block, which applies the "
-                "engine eagerly one multi-RHS block at a time")
+                "apply); drive them with the EAGER solver family instead — "
+                "solve.lanczos_block (eigenpairs, one multi-RHS block "
+                "apply at a time, thick-restartable via max_basis_size), "
+                "solve.kpm (Chebyshev/KPM spectral densities), "
+                "solve.evolve (Krylov exp(-iHt) time evolution) — each "
+                "streams the plan once per eager apply")
         return self._apply_fn, self._operands
 
     def structure_arrays(self) -> dict:

@@ -194,20 +194,26 @@ class Scheduler:
     # -- packing -----------------------------------------------------------
 
     def next_batch(self) -> List[JobSpec]:
-        """Up to ``block_width`` queued jobs of ONE engine-key group:
-        the group whose head job queued earliest goes first (FIFO
-        fairness across groups), members ordered by (submit_ts, job_id)
-        — deterministic, so a rerun of the same queue packs the same
-        batches (the §26 bit-identity argument)."""
-        groups: Dict[str, List[JobSpec]] = {}
+        """Up to ``block_width`` queued jobs of ONE (engine-key, solver)
+        group: the group whose head job queued earliest goes first
+        (FIFO fairness across groups), members ordered by (submit_ts,
+        job_id) — deterministic, so a rerun of the same queue packs the
+        same batches (the §26 bit-identity argument).  Dynamics jobs
+        (solver kpm/evolve — DESIGN.md §29) group by the same engine
+        key, so they still hit the warm engine a same-basis eigensolve
+        built, but run ONE per batch: their state is a whole
+        moment/trajectory recurrence, not a column of a shared block."""
+        groups: Dict[tuple, List[JobSpec]] = {}
         for s in self.queue.queued():
-            groups.setdefault(s.engine_key(), []).append(s)
+            solver = getattr(s, "solver", "eigs") or "eigs"
+            groups.setdefault((s.engine_key(), solver), []).append(s)
         if not groups:
             return []
-        head = min(groups.values(),
-                   key=lambda g: min((s.submit_ts, s.job_id) for s in g))
+        (_, solver), head = min(
+            groups.items(),
+            key=lambda kv: min((s.submit_ts, s.job_id) for s in kv[1]))
         head.sort(key=lambda s: (s.submit_ts, s.job_id))
-        return head[: self.block_width]
+        return head[: self.block_width if solver == "eigs" else 1]
 
     # -- execution ---------------------------------------------------------
 
@@ -225,6 +231,10 @@ class Scheduler:
             with obs_trace.span("serve_batch", kind="batch",
                                 engine_key=key, jobs=len(batch)):
                 eng = self.pool.acquire(batch[0])
+                solver = getattr(batch[0], "solver", "eigs") or "eigs"
+                if solver != "eigs":
+                    return [self._run_dynamics(batch[0], eng, solver,
+                                               t_start)]
                 p = max(len(batch), max(int(s.k) for s in batch), 2)
                 V0 = self._start_block(eng, batch, p)
                 targets = [{"k": int(s.k), "tol": float(s.tol),
@@ -271,6 +281,51 @@ class Scheduler:
                 self._finish(spec, FAILED, t_start, error=repr(e))
             obs_emit("serve_batch_failed", engine_key=key, error=repr(e))
             return [self.queue.result(s.job_id) for s in batch]
+
+    def _run_dynamics(self, spec: JobSpec, eng, solver: str,
+                      t_start: float) -> dict:
+        """One dynamics job (solver kpm/evolve, DESIGN.md §29) on the
+        group's warm engine — the engine acquisition, admission pricing
+        and spool lifecycle are exactly the eigensolve path's; only the
+        solver call differs.  ``Preempted`` propagates to the caller
+        (requeue + exit 75 — the job-level checkpoint contract; a
+        requeued dynamics job restarts from its spool file)."""
+        from ..solve import kpm_dos, krylov_evolve
+
+        if solver == "kpm":
+            energies, rho, res = kpm_dos(
+                eng.matvec, n_moments=int(spec.n_moments),
+                n=int(eng.n_states), n_vectors=int(spec.n_vectors),
+                seed=spec.column_seed())
+            rec = self._finish(
+                spec, DONE, t_start, solver="kpm", converged=True,
+                bounds=[float(res.bounds[0]), float(res.bounds[1])],
+                n_moments=int(spec.n_moments),
+                moments_head=[float(m) for m in res.moments[:8]],
+                dos_peak=float(np.max(rho)),
+                moments_per_s=round(res.steady_moments_per_s, 3),
+                iters=int(res.num_applies))
+        else:
+            res = krylov_evolve(
+                eng.matvec, t_final=float(spec.t_final),
+                n=int(eng.n_states), krylov_dim=int(spec.krylov_dim),
+                tol=float(spec.tol), seed=spec.column_seed())
+            rec = self._finish(
+                spec, DONE, t_start, solver="evolve",
+                converged=bool(res.times[-1]
+                               >= float(spec.t_final) * (1 - 1e-12)),
+                t=float(res.times[-1]), steps=int(res.num_steps),
+                norm_drift=float(res.norm_drift),
+                energy_drift=float(res.energy_drift),
+                energy_final=float(res.energies[-1]),
+                iters=int(res.num_applies))
+        now = time.time()
+        with obs_trace.job_scope(spec.job_id):
+            obs_trace.emit_span(
+                f"job:{spec.job_id}", "job", t0=t_start,
+                dur_ms=(now - t_start) * 1e3,
+                engine_key=spec.engine_key(), solver=solver)
+        return rec
 
     def _finish(self, spec: JobSpec, status: str, t_start: float,
                 **result) -> dict:

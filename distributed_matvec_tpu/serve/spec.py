@@ -43,10 +43,22 @@ class JobSpec:
     edges: Optional[list] = None       # [[i, j], ...]; None = chain
     yaml: Optional[str] = None         # config path (diagonalize --submit)
     # -- solver targets ----------------------------------------------------
+    #: solver kind: ``eigs`` (lowest-k eigenpairs, the batched
+    #: lanczos_block path), ``kpm`` (Chebyshev/KPM spectral density) or
+    #: ``evolve`` (Krylov exp(-iHt) time evolution) — DESIGN.md §29.
+    #: Dynamics jobs share the SAME warm engines (grouped by engine_key
+    #: like everything else) but run one job per batch: their state is a
+    #: trajectory, not a column of a shared block.
+    solver: str = "eigs"
     k: int = 1
     tol: float = 1e-10
     max_iters: int = 400
     seed: Optional[int] = None         # start-column seed; None = from job_id
+    # -- dynamics targets (solver="kpm" / "evolve") ------------------------
+    n_moments: int = 256               # kpm: Chebyshev moment count
+    n_vectors: int = 4                 # kpm: stochastic-trace columns
+    t_final: float = 1.0               # evolve: trajectory length
+    krylov_dim: int = 24               # evolve: per-step Krylov dimension
     # -- engine shape ------------------------------------------------------
     mode: str = "ell"
     n_devices: int = 0                 # 0/1 = LocalEngine (unless streamed)
@@ -64,6 +76,20 @@ class JobSpec:
             raise ValueError(
                 "JobSpec needs exactly one model source: inline "
                 "basis(+edges) or a yaml config path")
+        if self.solver not in ("eigs", "kpm", "evolve"):
+            raise ValueError(
+                f"unknown solver kind {self.solver!r} "
+                "(use eigs | kpm | evolve)")
+        if self.solver == "kpm":
+            if int(self.n_moments) < 2:
+                raise ValueError("kpm jobs need n_moments >= 2")
+            if int(self.n_vectors) < 1:
+                raise ValueError("kpm jobs need n_vectors >= 1")
+        if self.solver == "evolve":
+            if not float(self.t_final) > 0.0:
+                raise ValueError("evolve jobs need t_final > 0")
+            if int(self.krylov_dim) < 2:
+                raise ValueError("evolve jobs need krylov_dim >= 2")
 
     # -- scheduling --------------------------------------------------------
 
@@ -157,7 +183,15 @@ class JobSpec:
                 "mode": self.mode, "n_devices": max(int(self.n_devices), 1),
                 "pair": False, "k": int(self.k),
                 "max_iters": int(self.max_iters),
-                "group_order": int(group_order)}
+                "group_order": int(group_order),
+                # dynamics pricing inputs (price_job converts moment /
+                # trajectory budgets into matvec-column counts at the
+                # same calibrated est ms/apply eigensolves price at)
+                "solver": self.solver,
+                "n_moments": int(self.n_moments),
+                "n_vectors": int(self.n_vectors),
+                "t_final": float(self.t_final),
+                "krylov_dim": int(self.krylov_dim)}
 
     # -- JSON --------------------------------------------------------------
 

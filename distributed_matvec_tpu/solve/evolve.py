@@ -1,0 +1,443 @@
+"""Krylov ``exp(-iHt)`` time evolution over the engines (DESIGN.md §29).
+
+Each accepted step projects the propagator onto a small Krylov space:
+``psi(t + dt) ~= ||psi|| * V_m exp(-i dt T_m) e_1`` with ``V_m`` built by
+``m`` eager engine applies (Lanczos with one full reorthogonalization
+pass — m is small, the matmuls are trivial next to the matvec) and
+``T_m`` the m-by-m real symmetric tridiagonal, exponentiated on the host
+through its eigendecomposition.
+
+Complex states on REAL-sector engines ride the multi-RHS path: a real
+Hamiltonian acts on Re and Im independently, so ``psi`` is applied as a
+2-column real block ``[Re psi, Im psi]`` — ONE engine apply per Krylov
+vector, and a streamed engine streams each plan chunk once per apply
+with its plan built once for the whole trajectory.  Complex-sector
+engines (native c128 on CPU) consume complex states directly.
+Pair-mode engines (the TPU (re, im) form) are refused with a pointer.
+
+Adaptive stepping is free of extra applies: the Krylov basis is valid
+for ANY dt, so a rejected step only re-exponentiates the SAME small T
+at dt/2 — the residual-based local error estimate
+``err(dt) = beta_m * |[exp(-i dt T)]_{m,1}|`` (Saad '92) prices the
+step before the state is committed.  Acceptance is deterministic in the
+state, so trajectories are reproducible and a preempted-and-resumed run
+(checkpoint restores psi, t, dt bit-exactly) continues bit-consistent
+with the uninterrupted one.
+
+Telemetry: per-step ``evolve_trace`` events carry t, dt, the error
+estimate, the norm drift ``| ||psi|| - 1 |`` (the propagator is unitary;
+drift is pure roundoff and a numerical-health signal) and the energy
+drift ``|E(t) - E(0)|`` (H commutes with its own propagator; the
+recurrence's first alpha is <psi|H|psi> for free).  Solver contracts
+match the eigensolvers: preemption latch at accepted-step boundaries,
+checkpoint/resume through the shared topology-portable machinery, and
+``solve > iteration > apply`` spans.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import memory as obs_memory
+from ..obs import trace as obs_trace
+from ..obs.events import emit as obs_emit, flush as obs_flush, obs_enabled
+from ..utils import faults, preempt
+from .lanczos import (_operator_key, _rand_like, _restore_ckpt,
+                      _sharded_ckpt_engine, _soft_save_ckpt)
+
+__all__ = ["EvolveResult", "krylov_evolve"]
+
+#: breakdown threshold: a residual norm this far below the state scale
+#: means the Krylov space closed and exp(-i dt T) is exact ("happy
+#: breakdown" — the step is accepted with zero error estimate)
+_BREAKDOWN = 1e-14
+
+
+@dataclass
+class EvolveResult:
+    psi: object                     # final state, engine layout, complex
+    times: np.ndarray               # [steps + 1] accepted times (t_0 = 0)
+    energies: np.ndarray            # [steps + 1] <psi|H|psi> trajectory
+    norm_drift: float               # max | ||psi|| - 1 | over the run
+    energy_drift: float             # max |E(t) - E(0)| / max(1, |E(0)|)
+    num_steps: int
+    num_applies: int
+    num_rejects: int = 0
+    resumed_from: int = 0           # accepted steps restored from ckpt
+    observables: Optional[dict] = None   # name -> [(t, value), ...]
+    first_step_seconds: float = 0.0
+    steady_seconds: float = 0.0
+
+    @property
+    def steady_steps_per_s(self) -> float:
+        """Accepted-step rate over the steady window: steps taken THIS
+        run (checkpoint-restored ones cost this run nothing) minus the
+        compile-bearing first."""
+        rest = self.num_steps - self.resumed_from - 1
+        if rest > 0 and self.steady_seconds > 0:
+            return rest / self.steady_seconds
+        return 0.0
+
+
+def krylov_evolve(matvec: Callable, psi0=None, t_final: float = 1.0,
+                  **kwargs) -> EvolveResult:
+    """Solve-span wrapper over :func:`_krylov_evolve_impl` (full
+    contract there): the trajectory is ONE ``solve`` span, each accepted
+    step an ``iteration`` span, the eager engine applies nest as
+    ``apply`` spans."""
+    with obs_trace.span("evolve", kind="solve", t_final=float(t_final)):
+        return _krylov_evolve_impl(matvec, psi0=psi0, t_final=t_final,
+                                   **kwargs)
+
+
+def _krylov_evolve_impl(
+    matvec: Callable,
+    psi0=None,
+    t_final: float = 1.0,
+    n: Optional[int] = None,
+    dt0: Optional[float] = None,
+    krylov_dim: int = 24,
+    tol: float = 1e-12,
+    seed: int = 0,
+    max_steps: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 8,
+    observables=None,
+    obs_every: int = 1,
+) -> EvolveResult:
+    """Evolve ``psi0`` under ``exp(-i H t)`` to ``t_final``.
+
+    ``psi0`` is a state in the matvec's layout (real or complex; None
+    draws a seeded normalized random state — useful for dynamical
+    correlation baselines).  ``tol`` is the local-error budget PER UNIT
+    TIME: a step of size dt is accepted when its Krylov residual
+    estimate is below ``tol * dt``, so the accumulated error over the
+    trajectory is ~``tol * t_final``.  ``dt0`` seeds the adaptive step
+    (default ``t_final / 16``); accepted steps grow by sqrt(2) while the
+    estimate stays an order under budget, rejected steps halve and
+    re-exponentiate the same basis (no extra applies).  ``max_steps``
+    bounds the accepted-step count (the remaining trajectory is simply
+    not taken — a budget exit, reported unfinished via
+    ``times[-1] < t_final``).
+
+    ``observables`` is a list of ``models/observables.BoundObservable``
+    (or ``(name, callable)`` pairs) evaluated against the state every
+    ``obs_every`` accepted steps; values land in
+    :attr:`EvolveResult.observables`.
+
+    Checkpoint/resume (``checkpoint_path``): the state + (t, dt, step,
+    drift) are written through the shared topology-portable machinery
+    every ``checkpoint_every`` accepted steps and at preemption; the
+    fingerprint bakes in the operator key, layout, dtype and the
+    (t_final, tol, krylov_dim) plan.  Restores are bit-exact, and step
+    acceptance is deterministic in the state — a resumed trajectory is
+    bit-consistent with an uninterrupted one (gated by
+    ``make dynamics-check``).
+    """
+    from .kpm import _refuse_pair
+
+    owner = getattr(matvec, "__self__", None)
+    _refuse_pair(owner, "krylov_evolve")
+    t_final = float(t_final)
+    if not t_final > 0.0:
+        raise ValueError(f"t_final must be > 0, got {t_final}")
+    m_cap = max(int(krylov_dim), 2)
+
+    def raw_mv(x):
+        y = matvec(x)
+        return y[0] if isinstance(y, tuple) else y
+
+    if psi0 is None:
+        if owner is not None and hasattr(owner, "random_hashed"):
+            psi0 = owner.random_hashed(seed)
+        elif n is not None:
+            psi0 = _rand_like((n,), np.float64, seed)
+        else:
+            raise ValueError("pass psi0 or n")
+    psi = jnp.asarray(psi0)
+    # complex support: a REAL-sector engine gets the 2-column real
+    # trick, a complex-sector (c128) engine runs native.  Engine-backed
+    # matvecs answer this STATICALLY (operator.effective_is_real /
+    # engine dtype — the same rule models/observables applies), so no
+    # probe apply is spent; only a bare callable pays one probe (on a
+    # giant streamed engine an apply streams the whole plan)
+    if owner is not None:
+        from ..models.observables import _complex_native
+        complex_native = _complex_native(owner)
+        napply = 0
+    else:
+        probe = raw_mv(psi.real if jnp.iscomplexobj(psi) else psi)
+        complex_native = jnp.iscomplexobj(probe)
+        napply = 1
+        del probe
+    cdtype = jnp.promote_types(jnp.complex128, psi.dtype)
+    psi = psi.astype(cdtype)
+    shape = psi.shape
+
+    if complex_native:
+        def apply_c(z):
+            return raw_mv(z).astype(cdtype)
+    else:
+        def apply_c(z):
+            # ONE engine apply of the 2-column real block [Re z, Im z]:
+            # a real H acts on the parts independently, and the block
+            # rides the same multi-RHS path lanczos_block batches
+            # through (a streamed plan chunk uploads once per apply)
+            blk = jnp.stack([jnp.real(z), jnp.imag(z)], axis=-1)
+            w = raw_mv(blk)
+            return (w[..., 0] + 1j * w[..., 1]).astype(cdtype)
+
+    nrm0 = float(jnp.sqrt(jnp.real(jnp.vdot(psi, psi))))
+    if not np.isfinite(nrm0) or nrm0 <= 0.0:
+        raise ValueError("psi0 has no norm")
+    psi = psi / nrm0
+
+    dt = float(dt0) if dt0 else t_final / 16.0
+    dt_max = t_final / 2.0
+    t = 0.0
+    step = 0
+    rejects = 0
+    norm_drift = 0.0
+    energy_drift = 0.0
+    e0_ref: Optional[float] = None
+    times: List[float] = [0.0]
+    energies: List[float] = []
+    obs_vals: dict = {}
+    obs_list = []
+    for o in (observables or ()):
+        if hasattr(o, "expectation"):
+            obs_list.append((getattr(o, "name", None) or "observable",
+                             o.expectation))
+        else:
+            obs_list.append((o[0], o[1]))
+
+    agree_multi = jax.process_count() > 1 and (
+        owner is None or bool(getattr(owner, "_multi", True)))
+    preempt.ensure_installed()
+
+    hashed_layout = _sharded_ckpt_engine(owner, shape)
+    base = (f"hashed{tuple(shape[2:])}" if hashed_layout
+            else f"{tuple(shape)}")
+    # seed is part of the trajectory identity: a rerun with a different
+    # --seed must start fresh, never restore another start state's
+    # trajectory.  An EXPLICIT psi0 keys by seed too (its content is
+    # not hashed — fetching a sharded state just to fingerprint it
+    # would cost a full D2H pass); reruns that change psi0 under the
+    # same path are the caller's responsibility, the same contract as
+    # bare-callable Lanczos checkpoints.
+    ckpt_fp = (f"{base}|{np.dtype(cdtype).str}|{_operator_key(owner)}"
+               f"|evolve-v1|t{t_final!r}|tol{float(tol)!r}|m{m_cap}"
+               f"|s{int(seed)}")
+    multi = jax.process_count() > 1
+    sharded_ckpt = multi and hashed_layout
+    if checkpoint_path and multi and not sharded_ckpt:
+        from ..utils.logging import log_debug
+        log_debug("evolve checkpointing disabled: multi-process run with "
+                  "a non-engine matvec (no per-shard vector layout)")
+        checkpoint_path = None
+    resumed_from = 0
+    if checkpoint_path:
+        got = _restore_ckpt(checkpoint_path, ckpt_fp, owner, shape,
+                            sharded=sharded_ckpt, solver="evolve",
+                            dtype=np.dtype(cdtype))
+        if got is not None:
+            psi = got["V_rows"][0].astype(cdtype)
+            t = float(got["t"])
+            dt = float(got["dt"])
+            step = resumed_from = int(got["total_iters"])
+            norm_drift = float(got["norm_drift"])
+            energy_drift = float(got["energy_drift"])
+            # NaN marks "no step accepted yet" — restoring a literal
+            # 0.0 there would poison the drift reference and skip the
+            # t=0 observable sample on resume
+            _e0 = float(got["e0_ref"])
+            e0_ref = None if np.isnan(_e0) else _e0
+            times = [float(x) for x in np.asarray(got["times"])]
+            energies = [float(x) for x in np.asarray(got["energies"])]
+            # observable trajectories resume too (stored in obs_list
+            # ORDER — the same-argv resume contract); a changed
+            # observable count means a different run: series start fresh
+            ser = got.get("obs_series")
+            if ser is not None and obs_list \
+                    and np.asarray(ser).shape[0] == len(obs_list):
+                ser = np.asarray(ser)
+                for (name, _), row in zip(obs_list, ser):
+                    obs_vals[name] = [(float(tt), float(vv))
+                                      for tt, vv in row]
+            obs_emit("solver_resume", solver="evolve", iters=int(step),
+                     t=float(t))
+
+    obs_emit("solver_start", solver="evolve", t_final=t_final,
+             tol=float(tol), krylov_dim=int(m_cap),
+             complex_native=bool(complex_native),
+             resumed_from=int(resumed_from))
+
+    mem_h = obs_memory.NULL_HANDLE
+    if obs_enabled():
+        mem_h = obs_memory.track(
+            f"solver/{obs_memory.next_instance('evolve')}/krylov_basis",
+            (m_cap + 1) * int(psi.nbytes), krylov_dim=int(m_cap))
+
+    def save_ckpt(reason):
+        meta = {
+            "t": float(t), "dt": float(dt), "m": 0,
+            "total_iters": int(step), "norm_drift": float(norm_drift),
+            "energy_drift": float(energy_drift),
+            "e0_ref": float(e0_ref) if e0_ref is not None else np.nan,
+            "times": np.asarray(times), "energies": np.asarray(energies)}
+        if obs_list and obs_vals:
+            # [n_obs, K, 2] (t, value) series in obs_list order, so a
+            # same-argv resume returns the FULL trajectory aligned
+            # with times, not a post-resume stub
+            meta["obs_series"] = np.asarray(
+                [[[tt, vv] for tt, vv in obs_vals.get(name, [])]
+                 for name, _ in obs_list])
+        _soft_save_ckpt(checkpoint_path, ckpt_fp, owner, psi[None], meta,
+                        0, sharded_ckpt, solver="evolve", reason=reason)
+
+    def eval_observables():
+        for name, fn in obs_list:
+            obs_vals.setdefault(name, []).append((t, fn(psi)))
+
+    first_s = 0.0
+    steady_s = 0.0
+    while t < t_final * (1.0 - 1e-15):
+        if max_steps is not None and step - resumed_from >= int(max_steps):
+            break
+        faults.check("solver_block", exc=RuntimeError, solver="evolve",
+                     iter=int(step))
+        if preempt.agreed(agree_multi):
+            if checkpoint_path:
+                save_ckpt("preempt")
+            obs_emit("solver_preempted", solver="evolve", iters=int(step),
+                     checkpoint=checkpoint_path or "")
+            obs_flush()
+            mem_h.release()
+            raise preempt.Preempted("evolve", step, checkpoint_path)
+        t_wall = time.perf_counter()
+        with obs_trace.span("iteration", kind="iteration",
+                            solver="evolve", iter=int(step), t=float(t)):
+            # -- Krylov basis for THIS state (valid for any dt) --------
+            nrm = float(jnp.sqrt(jnp.real(jnp.vdot(psi, psi))))
+            V = [psi / nrm]
+            alph: List[float] = []
+            bet: List[float] = []
+            breakdown = False
+            for jj in range(m_cap):
+                w = apply_c(V[jj])
+                napply += 1
+                a = float(jnp.real(jnp.vdot(V[jj], w)))
+                w = w - a * V[jj]
+                if jj:
+                    w = w - bet[jj - 1] * V[jj - 1]
+                # one full reorthogonalization pass: m is small, the
+                # dots are trivial next to the matvec, and the small-T
+                # exponential needs an orthonormal basis
+                for vi in V:
+                    w = w - jnp.vdot(vi, w) * vi
+                alph.append(a)
+                b = float(jnp.sqrt(jnp.real(jnp.vdot(w, w))))
+                if b <= _BREAKDOWN * max(abs(a), 1.0):
+                    breakdown = True
+                    bet.append(b)
+                    break
+                bet.append(b)
+                V.append(w / b)
+            m_eff = len(alph)
+            T = np.diag(np.asarray(alph))
+            for i in range(m_eff - 1):
+                T[i + 1, i] = T[i, i + 1] = bet[i]
+            theta, S = np.linalg.eigh(T)
+            # energies[i] = <psi|H|psi> at times[i]; the recurrence's
+            # first alpha IS the energy of the state this step starts
+            # from, so the trajectory records it for free
+            if len(energies) < len(times):
+                energies.append(alph[0])
+                if e0_ref is None:
+                    e0_ref = alph[0]
+                    eval_observables()
+
+            # -- adaptive acceptance: rejections re-exponentiate the
+            # SAME T, no applies --------------------------------------
+            dt_try = min(dt, t_final - t)
+            while True:
+                u = S @ (np.exp(-1j * dt_try * theta) * S[0, :])
+                err = (0.0 if breakdown
+                       else abs(bet[m_eff - 1] * u[m_eff - 1]))
+                if err <= float(tol) * dt_try or dt_try <= 1e-12 * t_final:
+                    break
+                rejects += 1
+                obs_emit("evolve_reject", solver="evolve", iter=int(step),
+                         dt=float(dt_try), err=float(err))
+                dt_try *= 0.5
+
+            # -- commit ------------------------------------------------
+            uj = jnp.asarray(u, dtype=cdtype)
+            psi_new = nrm * sum(uj[i] * V[i] for i in range(m_eff))
+            jax.block_until_ready(psi_new)
+            psi = psi_new
+            t += dt_try
+            step += 1
+            nrm_new = float(jnp.sqrt(jnp.real(jnp.vdot(psi, psi))))
+            norm_drift = max(norm_drift, abs(nrm_new - 1.0))
+            e_t = alph[0]           # <psi|H|psi> at the step START
+            energy_drift = max(energy_drift,
+                               abs(e_t - e0_ref) / max(1.0, abs(e0_ref)))
+            times.append(t)
+            if obs_list and step % max(int(obs_every), 1) == 0:
+                eval_observables()
+            # grow only when the estimate is an order under budget (and
+            # never past the remaining trajectory / dt_max)
+            if not breakdown and err < 0.1 * float(tol) * dt_try:
+                dt = min(dt_try * 1.41421356, dt_max)
+            else:
+                dt = dt_try
+        dwall = time.perf_counter() - t_wall
+        if step - resumed_from == 1:
+            first_s = dwall
+        else:
+            steady_s += dwall
+        if obs_enabled():
+            obs_emit("evolve_trace", solver="evolve", iter=int(step),
+                     t=float(t), dt=float(dt_try), err=float(err),
+                     krylov_m=int(m_eff), energy=float(e_t),
+                     norm_drift=float(norm_drift),
+                     energy_drift=float(energy_drift))
+        if checkpoint_path and \
+                (step - resumed_from) % max(int(checkpoint_every), 1) == 0:
+            save_ckpt("cadence")
+
+    # close the energy trajectory at the FINAL state (one extra apply —
+    # trivial next to the trajectory) so energies aligns with times;
+    # this also covers a run that never took a step
+    if len(energies) < len(times):
+        w = apply_c(psi)
+        napply += 1
+        nrm2 = float(jnp.real(jnp.vdot(psi, psi)))
+        e_fin = float(jnp.real(jnp.vdot(psi, w))) / max(nrm2, 1e-300)
+        if e0_ref is None:
+            e0_ref = e_fin
+            eval_observables()
+        energies.append(e_fin)
+        energy_drift = max(energy_drift,
+                           abs(e_fin - e0_ref) / max(1.0, abs(e0_ref)))
+
+    obs_emit("solver_end", solver="evolve", iters=int(step),
+             converged=bool(t >= t_final * (1.0 - 1e-12)),
+             t=float(t), num_applies=int(napply),
+             norm_drift=float(norm_drift),
+             energy_drift=float(energy_drift))
+    mem_h.release()
+    return EvolveResult(
+        psi=psi, times=np.asarray(times), energies=np.asarray(energies),
+        norm_drift=float(norm_drift), energy_drift=float(energy_drift),
+        num_steps=step, num_applies=napply, num_rejects=rejects,
+        resumed_from=resumed_from,
+        observables=obs_vals if obs_list else None,
+        first_step_seconds=first_s, steady_seconds=steady_s)

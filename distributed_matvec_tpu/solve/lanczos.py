@@ -210,6 +210,9 @@ class LanczosResult:
     num_iters: int
     converged: bool
     resumed_from: int = 0            # iterations restored from a checkpoint
+    #: thick (memory-bounding) restarts taken by a ``max_basis_size``-
+    #: capped ``lanczos_block`` solve (narrowing restarts not counted)
+    restarts: int = 0
     # steady-state rate bookkeeping: the first block pays jit compile, so
     # iters/sec is (num_iters - first_block_iters) / steady_seconds
     first_block_seconds: float = 0.0
@@ -439,7 +442,10 @@ def _read_direct_rows(path, fp, owner, meta, n_rows, tail):
                     if not owner._shard_addressable(d):
                         continue
                     r = fetch(d, name=f"krylov_{i}")
-                    full = np.zeros((M,) + tail)
+                    # dtype from the stored rows: a complex snapshot
+                    # (the evolve solver's state) must not silently
+                    # cast through a float64 staging buffer
+                    full = np.zeros((M,) + tail, dtype=r.dtype)
                     full[: r.shape[0]] = r
                     pieces[d] = full
                 rows_out.append(owner._assemble_sharded(pieces))
@@ -853,6 +859,8 @@ def _lanczos_block_impl(
     V0=None,
     compute_eigenvectors: bool = False,
     column_targets=None,
+    max_basis_size: Optional[int] = None,
+    min_restart_size: Optional[int] = None,
 ) -> LanczosResult:
     """Lowest-``k`` eigenpairs via *block* Lanczos over the batched matvec.
 
@@ -866,10 +874,18 @@ def _lanczos_block_impl(
     ``[A_0 B_0ᵀ; B_0 A_1 …]``, and the residual bound for a Ritz pair
     (θ, s) is ``‖B_j · s[last p rows]‖``.
 
-    No thick restart: the basis grows to ``max_iters`` vectors, so this
-    targets modest iteration counts (degenerate/clustered spectra at small
-    k) rather than the long single-vector runs :func:`lanczos` handles
-    with bounded memory.  Pair-mode engines are refused — the J-aware
+    **Thick restarts** (``max_basis_size``): by default the basis grows
+    to ``max_iters`` vectors; with a cap, whenever the next step would
+    exceed it the basis is COMPRESSED to the ``min_restart_size``
+    (default: the block width) lowest Ritz vectors and the recurrence
+    restarts from that block — the same compression-restart machinery
+    the narrowing column exit uses (DESIGN.md §26/§29), so every
+    reported residual stays an exact recurrence residual.  This bounds
+    the Krylov workspace at ``max_basis_size`` columns — the only way a
+    streamed-engine solve at the chain_36-class rung keeps its solver
+    state in memory — at the price of more total iterations (each
+    epoch restarts from the best Ritz subspace, so convergence stays
+    monotone).  Pair-mode engines are refused — the J-aware
     reorthogonalization lives in :func:`lanczos`; complex sectors run
     natively here (CPU) or via :func:`lanczos` on TPU.
 
@@ -927,6 +943,21 @@ def _lanczos_block_impl(
     if targets is not None and len(targets) > p:
         raise ValueError(f"{len(targets)} column targets need a block of "
                          f"at least that many columns, got {p}")
+    mcap = l_thick = None
+    if max_basis_size is not None:
+        # restart width: the Ritz block the compression keeps — by
+        # default max(width, 2k+2): keeping only the k targets starves
+        # the restarted epoch near convergence (the residual directions
+        # of converged pairs collapse the next QR into a breakdown
+        # before the bound crosses tol — measured on chain_12 at
+        # tol 1e-13), while 2k+2 is the same slack the single-vector
+        # thick restart keeps.  The cap itself must leave the restart
+        # block room to grow by two steps, or the recurrence could
+        # never advance — undersized caps round UP to that minimum
+        # rather than refuse.
+        l_thick = max(int(min_restart_size) if min_restart_size
+                      else max(p, 2 * k + 2), k, 1)
+        mcap = max(int(max_basis_size), l_thick + 2 * p)
 
     hashed_owner = (owner is not None and hasattr(owner, "shard_size")
                     and hasattr(owner, "random_hashed"))
@@ -982,20 +1013,35 @@ def _lanczos_block_impl(
     converged = False
     total = 0
     p_cur = p
+    n_restarts = 0
     a_seq: list = []        # scalarized per-step (α, β) for the ω estimate
     b_seq: list = []
+    # thick-restart lock state (DESIGN.md §29): locked Ritz values, their
+    # orthonormal basis block, and the residual coupling of the FIRST
+    # active block to them — the block arrowhead, the same structure the
+    # single-vector solver's (lock_theta, lock_sigma) carry.  Locked
+    # vectors are never fed back through H (doing so collapses the next
+    # QR once a pair converges); the recurrence continues from the NEXT
+    # Krylov block, with the coupling keeping every residual exact.
+    lock_theta = np.zeros(0)
+    lock_Y = None                       # [n, l] locked Ritz block
+    lock_C = None                       # [widths[0], l] coupling row
 
     def _ritz_block(S_cols, m_rows):
-        """[n, c] Ritz combinations over the kept blocks covering the
-        first ``m_rows`` basis rows (snapshots are taken at step ends, so
-        block boundaries always align).  Reads ``blocks``/``widths`` at
-        CALL time — valid for any snapshot taken since the last
-        narrowing restart."""
-        offs = np.concatenate(([0], np.cumsum(widths))).astype(int)
-        nb = int(np.searchsorted(offs, m_rows))
+        """[n, c] Ritz combinations over the kept basis covering the
+        first ``m_rows`` rows — locked rows first, then the active
+        blocks (snapshots are taken at step ends, so block boundaries
+        always align).  Reads the lock/blocks state at CALL time —
+        valid for any snapshot taken since the last restart."""
+        l0 = int(lock_theta.shape[0])
         Sj = jnp.asarray(S_cols, dtype=dtype)
-        return sum(blocks[i] @ Sj[offs[i]: offs[i + 1]]
-                   for i in range(nb))
+        offs = np.concatenate(([0], np.cumsum(widths))).astype(int)
+        nb = int(np.searchsorted(offs, m_rows - l0))
+        out = sum(blocks[i] @ Sj[l0 + offs[i]: l0 + offs[i + 1]]
+                  for i in range(nb))
+        if l0:
+            out = lock_Y @ Sj[:l0] + out
+        return out
 
     def _assemble(S_cols, m_rows):
         """Normalized Ritz vectors in the matvec's layout."""
@@ -1056,11 +1102,14 @@ def _lanczos_block_impl(
             W = W - Qj @ A
             if B_list:          # empty right after a narrowing restart
                 W = W - blocks[-2] @ B_list[-1].conj().T
-            # full reorthogonalization, two passes (classic block-Lanczos
-            # loss of orthogonality is what makes the naive recurrence
-            # useless)
+            # full reorthogonalization, two passes, LOCKED block
+            # included (classic block-Lanczos loss of orthogonality is
+            # what makes the naive recurrence useless; the locked
+            # coupling is carried by the arrowhead, so the projection
+            # here just enforces exact orthogonality)
             for _ in range(2):
-                for Qi in blocks:
+                for Qi in (() if lock_Y is None else (lock_Y,)) \
+                        + tuple(blocks):
                     W = W - Qi @ (Qi.conj().T @ W)
             Qn, B = jnp.linalg.qr(W)
             jax.block_until_ready(Qn)
@@ -1073,7 +1122,8 @@ def _lanczos_block_impl(
         B_list.append(np.asarray(B))
         widths.append(p_cur)
         total += p_cur
-        m = sum(widths)
+        l0 = int(lock_theta.shape[0])
+        m = l0 + sum(widths)
         # scalarized (α, β) proxy for the ω-recurrence: the block analog of
         # β_j is the smallest new-direction magnitude min|diag(R_j)| — the
         # quantity whose collapse signals orthogonality/rank loss — and of
@@ -1081,18 +1131,26 @@ def _lanczos_block_impl(
         a_seq.append(float(np.max(np.abs(A_list[-1]))))
         b_seq.append(float(np.min(np.abs(np.diag(B_list[-1])))))
 
-        # projected block-tridiagonal matrix (Hermitian by construction;
-        # A is numerically Hermitian only to roundoff — symmetrize).
-        # Offsets come from the widths list; within one epoch (between
-        # narrowing restarts, which reset these lists) every block is
-        # p_cur wide, so all blocks here are square at widths[i]
-        T = np.zeros((m, m), dtype=np.result_type(*A_list))
-        off = 0
+        # projected matrix (Hermitian by construction; A is numerically
+        # Hermitian only to roundoff — symmetrize): block tridiagonal,
+        # preceded after a thick restart by the arrowhead — locked Ritz
+        # values on the diagonal, the coupling row against the first
+        # active block.  Offsets come from the widths list; within one
+        # epoch (between restarts, which reset these lists) every block
+        # is p_cur wide, so all blocks here are square at widths[i]
+        T = np.zeros((m, m), dtype=np.result_type(
+            *(A_list + ([lock_C] if lock_C is not None else []))))
+        if l0:
+            T[:l0, :l0] = np.diag(lock_theta)
+            w0 = widths[0]
+            T[l0: l0 + w0, :l0] = lock_C
+            T[:l0, l0: l0 + w0] = lock_C.conj().T
+        off = l0
         for i, Ai in enumerate(A_list):
             w = widths[i]
             T[off: off + w, off: off + w] = (Ai + Ai.conj().T) / 2
             off += w
-        off = 0
+        off = l0
         for i, Bi in enumerate(B_list[:-1]):
             w0, w1 = widths[i], widths[i + 1]
             T[off + w0: off + w0 + w1, off: off + w0] = Bi
@@ -1189,6 +1247,10 @@ def _lanczos_block_impl(
                 blocks = [Q0.astype(dtype)]
                 A_list, B_list, widths = [], [], []
                 a_seq, b_seq = [], []      # ω table resets with the basis
+                # the narrowing compression folds any locked block into
+                # Q0 (the _ritz_block above spans it) — lock state clears
+                lock_theta = np.zeros(0)
+                lock_Y = lock_C = None
                 obs_emit("solver_restart_narrow", solver="lanczos_block",
                          iters=int(total), width=int(p_cur),
                          new_width=int(p_new), basis_size=int(m),
@@ -1199,18 +1261,61 @@ def _lanczos_block_impl(
                               int(sum(b.nbytes for b in blocks)))
                 j += 1
                 continue
+        if mcap is not None and m + p_cur > mcap:
+            # Thick (memory-bounding) restart — the TRLan scheme in
+            # block form: keep the l_thick lowest Ritz vectors as a
+            # LOCKED block, continue the recurrence from the NEXT
+            # Krylov block Qn (already orthonormal to everything), and
+            # carry the exact coupling C = B·S[last rows] into the
+            # arrowhead of every later projection.  H is never applied
+            # to the locked vectors again — re-applying it is what
+            # collapses the next QR into a spurious breakdown once a
+            # pair converges — and H·(basis·S) = basis·S·Θ + Qn·C
+            # exactly, so every later residual bound stays an exact
+            # recurrence residual.  Finished targets' eigenvectors are
+            # materialized first: their snapshots reference the blocks
+            # this restart drops.
+            if compute_eigenvectors and targets:
+                for t in targets:
+                    snap = t.get("snapshot")
+                    if snap is not None and "vecs" not in snap:
+                        snap["vecs"] = _assemble(snap["S"], snap["m"])
+            ll = min(int(l_thick), m - 1)
+            theta_all, S_all = eigh(T)
+            Y_new = _ritz_block(S_all[:, :ll], m).astype(dtype)
+            C_new = np.asarray(B) @ S_all[m - widths[-1]:, :ll]
+            jax.block_until_ready(Y_new)
+            lock_theta = np.asarray(theta_all[:ll])
+            lock_Y = Y_new
+            lock_C = C_new             # [p_cur, ll]: next epoch's first
+            blocks = [Qn]              # block is Qn, width p_cur
+            A_list, B_list, widths = [], [], []
+            a_seq, b_seq = [], []      # ω table resets with the basis
+            n_restarts += 1
+            obs_emit("solver_restart_thick", solver="lanczos_block",
+                     iters=int(total), basis_size=int(m), kept=int(ll),
+                     width=int(p_cur), cap=int(mcap))
+            if blk_path is not None:
+                mem_h.set(blk_path,
+                          int(sum(b.nbytes for b in blocks)
+                              + lock_Y.nbytes))
+            j += 1
+            continue
         blocks.append(Qn)
         if blk_path is not None:
-            mem_h.set(blk_path, int(sum(b.nbytes for b in blocks)))
+            mem_h.set(blk_path, int(
+                sum(b.nbytes for b in blocks)
+                + (lock_Y.nbytes if lock_Y is not None else 0)))
         j += 1
 
-    kk = min(k, sum(widths)) if widths else 0
+    m_fin = int(lock_theta.shape[0]) + sum(widths)
+    kk = min(k, m_fin) if m_fin else 0
 
     evecs = None
     if compute_eigenvectors and theta is not None:
         # `blocks` may hold one extra (not yet projected) block when the
         # loop ran to its last step — _assemble() stops at the m-th row
-        evecs = _assemble(np.asarray(S[:, :kk]), sum(widths))
+        evecs = _assemble(np.asarray(S[:, :kk]), m_fin)
 
     column_results = None
     if targets is not None:
@@ -1224,7 +1329,7 @@ def _lanczos_block_impl(
                 snap = {"theta": np.asarray(theta[:kt]),
                         "res": np.asarray(res[:kt]),
                         "S": np.asarray(S[:, :kt]),
-                        "m": int(sum(widths)), "iters": int(total),
+                        "m": int(m_fin), "iters": int(total),
                         "converged": False}
             entry = {"job_id": t.get("job_id"), "k": int(t["k"]),
                      "tol": float(t["tol"]),
@@ -1255,6 +1360,7 @@ def _lanczos_block_impl(
         else np.zeros(0),
         num_iters=total,
         converged=converged,
+        restarts=n_restarts,
         first_block_seconds=first_block_s,
         first_block_iters=first_block_iters,
         steady_seconds=steady_s,
@@ -1343,9 +1449,13 @@ def _lanczos_impl(
         raise ValueError(
             "lanczos() traces the matvec into one jitted block program, "
             "which a streamed/hybrid engine cannot provide (its plan "
-            "lives in host RAM and streams per apply) — use "
-            "solve.lanczos_block, whose eager multi-RHS block applies "
-            "stream each plan chunk once per block")
+            "lives in host RAM and streams per apply) — streamed/hybrid "
+            "engines are driven by the EAGER solver family instead: "
+            "solve.lanczos_block (eigenpairs; multi-RHS block applies "
+            "stream each plan chunk once per block, thick-restartable "
+            "via max_basis_size), solve.kpm (Chebyshev/KPM spectral "
+            "densities), and solve.evolve (Krylov exp(-iHt) time "
+            "evolution)")
     if reorth is None:
         from ..utils.config import get_config
         reorth = get_config().lanczos_reorth

@@ -245,6 +245,39 @@ if os.environ.get("DMT_MH_HYBRID"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_DYN"):
+    # Dynamics leg (tests/test_dynamics.py, DESIGN.md §29): a streamed
+    # engine per rank over a RANK-LOCAL mesh (the CPU backend cannot run
+    # cross-process computations — same constraint as every fast leg
+    # here) inside a real 2-process jax.distributed job, driving BOTH
+    # dynamics solvers.  The rank-local problems are identical, so the
+    # parent asserts the printed KPM moment and the evolve energy agree
+    # across ranks to full precision — a broken recurrence cannot
+    # masquerade as a telemetry pass — and exactly one engine_init per
+    # rank (the plan is built once and reused across all moments AND
+    # the whole trajectory).
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.solve import kpm_moments, krylov_evolve
+
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="streamed")
+    kres = kpm_moments(eng.matvec, n_moments=48, n_vectors=2, seed=6)
+    assert abs(kres.moments[0] - 1.0) < 1e-12, kres.moments[0]
+    assert np.all(np.isfinite(kres.moments))
+    assert np.all(np.abs(kres.moments) <= 1.0 + 1e-9), \
+        np.abs(kres.moments).max()
+    eres = krylov_evolve(eng.matvec, t_final=0.5, krylov_dim=12,
+                         tol=1e-12, seed=6)
+    assert eres.norm_drift < 1e-10, eres.norm_drift
+    assert eres.energy_drift < 1e-10, eres.energy_drift
+    print(f"[p{pid}] DYN_MU1 {kres.moments[1]:.15e}", flush=True)
+    print(f"[p{pid}] DYN_E {eres.energies[0]:.15e}", flush=True)
+    print(f"[p{pid}] dyn: {eres.num_steps} evolve steps, "
+          f"{kres.num_applies} kpm applies", flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

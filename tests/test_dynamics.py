@@ -1,0 +1,599 @@
+"""Dynamics solvers vs the independent dense reference (DESIGN.md §29).
+
+KPM moments/DOS against the dense projected matrix's own Chebyshev
+recurrence and exact spectrum (broadening-aware: both sides carry the
+same Jackson kernel), Krylov ``exp(-iHt)`` against dense ``expm`` at
+rtol 1e-10, thick-restart block Lanczos against the full-memory solve
+at rtol 1e-12 with the workspace provably bounded, observables against
+dense expectation values, checkpoint/resume bit-consistency, the serve
+layer's dynamics job kinds, and a REAL 2-process rank-local-mesh leg.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_matvec_tpu import obs
+from distributed_matvec_tpu.parallel.engine import LocalEngine
+from distributed_matvec_tpu.solve import (jackson_kernel, kpm_dos,
+                                          kpm_moments,
+                                          kpm_spectral_function,
+                                          krylov_evolve, lanczos,
+                                          lanczos_block, lorentz_kernel,
+                                          reconstruct_dos, spectral_bounds)
+from distributed_matvec_tpu.solve.lanczos import _rand_like
+
+from test_operator import build_heisenberg, dense_effective_matrix
+
+SYMS_12 = [([*range(1, 12), 0], 0), ([*reversed(range(12))], 0)]
+
+
+@pytest.fixture(scope="module")
+def chain12():
+    """chain_12 symmetric sector: (op, dense H, LocalEngine)."""
+    op = build_heisenberg(12, 6, 1, SYMS_12)
+    op.basis.build()
+    h = dense_effective_matrix(op).real
+    return op, h, LocalEngine(op)
+
+
+@pytest.fixture(scope="module")
+def chain12_streamed(chain12):
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    op, _, _ = chain12
+    return DistributedEngine(op, n_devices=1, mode="streamed")
+
+
+def _dense_moments_same_vectors(h, scale, V0, n_moments):
+    """The reference Chebyshev recurrence on the dense matrix, SAME
+    start block — shares no algebra with solve/kpm.py's engine loop."""
+    a, b = scale
+    Ht = (h - b * np.eye(h.shape[0])) / a
+    t0, t1 = V0, Ht @ V0
+    mu = np.zeros((n_moments, V0.shape[1]))
+    mu[0] = (t0 * t0).sum(0)
+    mu[1] = (t0 * t1).sum(0)
+    j, filled = 1, 2
+    while filled < n_moments:
+        if 2 * j - 1 >= filled:
+            mu[2 * j - 1] = 2 * (t1 * t0).sum(0) - mu[1]
+            filled += 1
+        if 2 * j < n_moments and 2 * j >= filled:
+            mu[2 * j] = 2 * (t1 * t1).sum(0) - mu[0]
+            filled += 1
+        if filled < n_moments:
+            t0, t1 = t1, 2 * Ht @ t1 - t0
+            j += 1
+    return mu.mean(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# spectral bounds
+
+
+def test_spectral_bounds_bracket(chain12):
+    op, h, eng = chain12
+    w = np.linalg.eigvalsh(h)
+    lo, hi, napply = spectral_bounds(eng.matvec, n=op.basis.number_states,
+                                     iters=48, seed=3)
+    assert lo < w[0] and hi > w[-1], (lo, hi, w[0], w[-1])
+    # the margin must not be absurd: the bracket stays within 25% of
+    # the true span on each end
+    span = w[-1] - w[0]
+    assert lo > w[0] - 0.25 * span and hi < w[-1] + 0.25 * span
+    assert napply == 48
+
+
+# ---------------------------------------------------------------------------
+# KPM
+
+
+def test_kpm_moments_match_dense_recurrence(chain12):
+    """Engine moments == dense-matrix moments on the SAME seeded start
+    block, to recurrence precision."""
+    op, h, eng = chain12
+    n = op.basis.number_states
+    res = kpm_moments(eng.matvec, n_moments=64, n=n, n_vectors=3, seed=2)
+    V0 = _rand_like((n, 3), np.float64, 2)
+    V0 = V0 / np.linalg.norm(V0, axis=0, keepdims=True)
+    mu_ref = _dense_moments_same_vectors(h, res.scale, V0, 64)
+    np.testing.assert_allclose(res.moments, mu_ref, rtol=0, atol=1e-12)
+    assert res.moments[0] == 1.0
+    # doubling: ~n_moments/2 recurrence applies plus the bounds pass
+    assert res.num_applies <= 64 // 2 + 64 + 2
+
+
+def test_kpm_dos_matches_dense_spectrum_within_broadening(chain12):
+    """Broadening-aware DOS check: the stochastic-trace KPM density vs
+    the EXACT spectrum pushed through the SAME Jackson kernel — the
+    residual is stochastic-trace noise ~ sqrt(2/(N R)), not resolution
+    mismatch."""
+    op, h, eng = chain12
+    n = op.basis.number_states
+    w = np.linalg.eigvalsh(h)
+    energies, rho, res = kpm_dos(eng.matvec, n_moments=96, n=n,
+                                 n_vectors=6, seed=4)
+    a, b = res.scale
+    ang = np.arccos(np.clip((w - b) / a, -1.0, 1.0))
+    mu_exact = np.array([np.mean(np.cos(k * ang)) for k in range(96)])
+    _, rho_ref = reconstruct_dos(mu_exact, res.scale, npoints=512)
+    rel = np.linalg.norm(rho - rho_ref) / np.linalg.norm(rho_ref)
+    assert rel < 0.35, rel
+    # Jackson-kernel DOS is strictly positive and integrates to ~1
+    assert rho.min() > -1e-12
+    mass = np.trapezoid(rho, energies) if hasattr(np, "trapezoid") \
+        else np.trapz(rho, energies)
+    assert abs(mass - 1.0) < 0.02, mass
+
+
+def test_kpm_streamed_matches_local_same_block(chain12, chain12_streamed):
+    """A streamed engine's moment series equals the local engine's on
+    the same global start block — and its plan is built ONCE for the
+    whole run (engine_init counted once)."""
+    import jax.numpy as jnp
+    op, _, eng_l = chain12
+    eng = chain12_streamed
+    n = op.basis.number_states
+    V0 = _rand_like((n, 2), np.float64, 11)
+    V0 = V0 / np.linalg.norm(V0, axis=0, keepdims=True)
+    V0h = jnp.stack([eng.to_hashed(V0[:, i]) for i in range(2)], axis=-1)
+    obs.reset()
+    bounds = (-24.0, 14.0)
+    r_s = kpm_moments(eng.matvec, n_moments=32, V0=V0h, bounds=bounds)
+    r_l = kpm_moments(eng_l.matvec, n_moments=32, V0=jnp.asarray(V0),
+                      bounds=bounds)
+    np.testing.assert_allclose(r_s.moments, r_l.moments, rtol=0,
+                               atol=1e-12)
+    # the warm engine is reused across every moment apply: zero NEW
+    # engine builds inside the solve
+    assert len([e for e in obs.events("engine_init")]) == 0
+
+
+def test_kpm_kernels_and_reconstruction():
+    g_j = jackson_kernel(64)
+    assert g_j[0] == pytest.approx(1.0)
+    assert np.all(np.diff(g_j) < 0) and g_j[-1] > 0
+    g_l = lorentz_kernel(64)
+    assert g_l[0] == pytest.approx(1.0) and np.all(g_l > 0)
+    with pytest.raises(ValueError):
+        from distributed_matvec_tpu.solve.kpm import _kernel
+        _kernel("gauss", 8, 4.0)
+    # a pure point mass at x=0.3 reconstructs to a peak near E = a*0.3+b
+    mu = np.cos(np.arange(128) * np.arccos(0.3))
+    E, rho = reconstruct_dos(mu, (2.0, 1.0), npoints=1024)
+    assert abs(E[np.argmax(rho)] - (2.0 * 0.3 + 1.0)) < 0.05
+
+
+def test_kpm_spectral_function_weight(chain12):
+    """S(E) carries ||O psi||^2 of spectral weight; O = H makes the
+    integral computable against the dense reference."""
+    op, h, eng = chain12
+    n = op.basis.number_states
+    psi = _rand_like((n,), np.float64, 5)
+    psi /= np.linalg.norm(psi)
+    import jax.numpy as jnp
+    E, S, res, w2 = kpm_spectral_function(
+        eng.matvec, jnp.asarray(psi), eng.matvec, n_moments=64)
+    want_w2 = float(psi @ (h @ (h @ psi)))
+    assert w2 == pytest.approx(want_w2, rel=1e-10)
+    mass = np.trapezoid(S, E) if hasattr(np, "trapezoid") \
+        else np.trapz(S, E)
+    assert mass == pytest.approx(w2, rel=0.05)
+
+
+def test_kpm_checkpoint_resume_bit_consistent(chain12, tmp_path):
+    op, _, eng = chain12
+    n = op.basis.number_states
+    ck = str(tmp_path / "kpm_ck.h5")
+    full = kpm_moments(eng.matvec, n_moments=40, n=n, n_vectors=2,
+                       seed=5)
+    part = kpm_moments(eng.matvec, n_moments=40, n=n, n_vectors=2,
+                       seed=5, checkpoint_path=ck, checkpoint_every=4)
+    resumed = kpm_moments(eng.matvec, n_moments=40, n=n, n_vectors=2,
+                          seed=5, checkpoint_path=ck, checkpoint_every=4)
+    assert resumed.resumed_from > 0
+    # the resumed series must equal BOTH the checkpointing run it
+    # restored from and a checkpoint-free run, bit for bit
+    assert np.array_equal(part.moments, full.moments)
+    assert np.array_equal(resumed.moments, full.moments)
+
+
+def test_kpm_refuses_pair_engines():
+    class FakePair:
+        pair = True
+
+        def matvec(self, x):
+            return x
+    with pytest.raises(ValueError, match="pair-mode"):
+        kpm_moments(FakePair().matvec, n_moments=8, n=4)
+
+
+# ---------------------------------------------------------------------------
+# Krylov time evolution
+
+
+def test_evolve_matches_dense_expm(chain12):
+    from scipy.linalg import expm
+    op, h, eng = chain12
+    n = op.basis.number_states
+    psi0 = _rand_like((n,), np.float64, 7)
+    psi0 /= np.linalg.norm(psi0)
+    res = krylov_evolve(eng.matvec, psi0=psi0, t_final=2.0, tol=1e-12,
+                        krylov_dim=20)
+    ref = expm(-2.0j * h) @ psi0
+    np.testing.assert_allclose(np.asarray(res.psi), ref, rtol=0,
+                               atol=1e-10 * np.abs(ref).max())
+    assert res.times[-1] == pytest.approx(2.0)
+    assert len(res.times) == len(res.energies)
+
+
+def test_evolve_unitarity_and_energy_drift(chain12):
+    op, h, eng = chain12
+    n = op.basis.number_states
+    res = krylov_evolve(eng.matvec, n=n, t_final=3.0, tol=1e-12,
+                        krylov_dim=20, seed=1)
+    # the acceptance bound make dynamics-check gates: < 1e-12 PER STEP
+    assert res.norm_drift < 1e-12 * max(res.num_steps, 1)
+    assert res.energy_drift < 1e-11
+
+
+def test_evolve_streamed_multi_rhs_path(chain12, chain12_streamed):
+    """exp(-iHt) on a STREAMED engine (complex state as the 2-column
+    real block through the multi-RHS apply) matches dense expm; the
+    plan is reused across the whole trajectory."""
+    from scipy.linalg import expm
+    op, h, _ = chain12
+    eng = chain12_streamed
+    n = op.basis.number_states
+    psi0 = _rand_like((n,), np.float64, 9)
+    psi0 /= np.linalg.norm(psi0)
+    obs.reset()
+    res = krylov_evolve(eng.matvec, psi0=eng.to_hashed(psi0),
+                        t_final=1.0, tol=1e-12, krylov_dim=16)
+    assert len([e for e in obs.events("engine_init")]) == 0
+    ref = expm(-1.0j * h) @ psi0
+    got = eng.from_hashed(np.asarray(res.psi))
+    np.testing.assert_allclose(got, ref, rtol=0,
+                               atol=1e-10 * np.abs(ref).max())
+
+
+def test_evolve_complex_sector_native(rng):
+    from scipy.linalg import expm
+    op = build_heisenberg(8, 4, None, [([*range(1, 8), 0], 1)])
+    op.basis.build()
+    h = dense_effective_matrix(op)
+    eng = LocalEngine(op)
+    n = op.basis.number_states
+    psi0 = _rand_like((n,), np.complex128, 3)
+    psi0 /= np.linalg.norm(psi0)
+    res = krylov_evolve(eng.matvec, psi0=psi0, t_final=1.0, tol=1e-12,
+                        krylov_dim=16)
+    ref = expm(-1.0j * h) @ psi0
+    np.testing.assert_allclose(np.asarray(res.psi), ref, rtol=0,
+                               atol=1e-10)
+
+
+def test_evolve_checkpoint_resume_bit_consistent(chain12, tmp_path):
+    op, _, eng = chain12
+    n = op.basis.number_states
+    psi0 = _rand_like((n,), np.float64, 13)
+    psi0 /= np.linalg.norm(psi0)
+    ck = str(tmp_path / "ev_ck.h5")
+    kw = dict(t_final=2.0, tol=1e-12, krylov_dim=16)
+    part = krylov_evolve(eng.matvec, psi0=psi0, max_steps=3,
+                         checkpoint_path=ck, checkpoint_every=1, **kw)
+    assert part.num_steps == 3 and part.times[-1] < 2.0
+    done = krylov_evolve(eng.matvec, psi0=psi0, checkpoint_path=ck, **kw)
+    solo = krylov_evolve(eng.matvec, psi0=psi0, **kw)
+    assert done.resumed_from == 3
+    # BIT-consistent with the uninterrupted trajectory (the §29
+    # acceptance): same accepted steps, same state bits
+    assert np.array_equal(done.times, solo.times)
+    assert np.array_equal(np.asarray(done.psi), np.asarray(solo.psi))
+    assert np.array_equal(done.energies, solo.energies)
+
+
+def test_evolve_observable_trajectory(chain12):
+    from distributed_matvec_tpu.models.observables import bind_observables
+    op, h, eng = chain12
+    n = op.basis.number_states
+    bo = bind_observables([op], eng)     # H as the (commuting) observable
+    res = krylov_evolve(eng.matvec, n=n, t_final=1.0, tol=1e-12,
+                        krylov_dim=16, seed=2, observables=bo)
+    series = res.observables[bo[0].name]
+    assert len(series) == res.num_steps + 1
+    vals = np.array([v for _, v in series])
+    # <H> is conserved under exp(-iHt)
+    np.testing.assert_allclose(vals, vals[0], rtol=0, atol=1e-10)
+    np.testing.assert_allclose(vals[0], res.energies[0], rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# thick-restart lanczos_block
+
+
+def test_thick_restart_parity_and_bounded_workspace(chain12):
+    op, h, eng = chain12
+    n = op.basis.number_states
+    w = np.linalg.eigvalsh(h)
+    obs.reset()
+    full = lanczos_block(eng.matvec, n=n, k=2, tol=1e-13, max_iters=300,
+                         seed=3, compute_eigenvectors=True)
+    thick = lanczos_block(eng.matvec, n=n, k=2, tol=1e-13, max_iters=600,
+                          seed=3, max_basis_size=16,
+                          compute_eigenvectors=True)
+    assert thick.converged and thick.restarts > 0
+    np.testing.assert_allclose(thick.eigenvalues, full.eigenvalues,
+                               rtol=1e-12)
+    np.testing.assert_allclose(thick.eigenvalues, w[:2], atol=1e-9)
+    # the Krylov workspace stayed bounded at the configured cap: every
+    # restart event fired at a basis size within it
+    evs = [e for e in obs.events("solver_restart_thick")]
+    assert len(evs) == thick.restarts
+    assert all(e["basis_size"] <= e["cap"] for e in evs)
+    assert all(e["cap"] == 16 for e in evs)
+    # eigenvectors from the restarted basis are genuine eigenvectors
+    v = thick.eigenvectors[0]
+    hv = np.asarray(eng.matvec(v))
+    r = np.linalg.norm(hv - thick.eigenvalues[0] * np.asarray(v))
+    assert r < 1e-8, r
+
+
+def test_thick_restart_streamed_engine(chain12, chain12_streamed):
+    """The memory-bounded solve drives a streamed engine (the chain_36
+    rung's solver loop) and lands the same E0."""
+    op, h, _ = chain12
+    eng = chain12_streamed
+    w = np.linalg.eigvalsh(h)
+    res = lanczos_block(eng.matvec, k=1, tol=1e-12, max_iters=400,
+                        seed=4, max_basis_size=12)
+    assert res.converged and res.restarts > 0
+    assert abs(res.eigenvalues[0] - w[0]) < 1e-9
+
+
+def test_lanczos_refusal_points_at_solver_table(chain12_streamed):
+    with pytest.raises(ValueError, match="solve.kpm"):
+        lanczos(chain12_streamed.matvec, n=8)
+    with pytest.raises(NotImplementedError, match="solve.evolve"):
+        chain12_streamed.bound_matvec()
+
+
+@pytest.mark.slow
+def test_thick_restart_chain_24_symm_acceptance():
+    """The §29 acceptance rung: chain_24_symm E0 at rtol 1e-12 with the
+    Krylov workspace bounded at the configured restart width, on a
+    streamed engine."""
+    from distributed_matvec_tpu.parallel.distributed import DistributedEngine
+    op = build_heisenberg(24, 12, 1, [([*range(1, 24), 0], 0),
+                                      ([*reversed(range(24))], 0)])
+    op.basis.build()
+    eng = DistributedEngine(op, n_devices=1, mode="streamed")
+    obs.reset()
+    full = lanczos_block(eng.matvec, k=1, tol=1e-13, max_iters=260,
+                         seed=3)
+    thick = lanczos_block(eng.matvec, k=1, tol=1e-13, max_iters=600,
+                          seed=3, max_basis_size=24)
+    assert thick.restarts > 0
+    evs = [e for e in obs.events("solver_restart_thick")]
+    assert all(e["basis_size"] <= 24 for e in evs)
+    np.testing.assert_allclose(thick.eigenvalues[0], full.eigenvalues[0],
+                               rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# observables
+
+
+def test_observables_vs_dense(chain12):
+    import jax.numpy as jnp
+
+    from distributed_matvec_tpu.models.observables import (
+        bind_observables, expectations)
+    op, h, eng = chain12
+    n = op.basis.number_states
+    psi_r = _rand_like((n,), np.float64, 3)
+    psi_r /= np.linalg.norm(psi_r)
+    psi_c = _rand_like((n,), np.complex128, 4)
+    psi_c /= np.linalg.norm(psi_c)
+    bo = bind_observables([op], eng)[0]
+    assert bo.name
+    want_r = float(psi_r @ (h @ psi_r))
+    want_c = float(np.real(psi_c.conj() @ (h @ psi_c)))
+    assert bo.expectation(jnp.asarray(psi_r)) == pytest.approx(
+        want_r, abs=1e-10)
+    # COMPLEX state against a real-sector O: the 2-column real block
+    assert bo.expectation(jnp.asarray(psi_c)) == pytest.approx(
+        want_c, abs=1e-10)
+    vals = expectations([op], eng, jnp.asarray(psi_c))
+    assert vals[0][1] == pytest.approx(want_c, abs=1e-10)
+
+
+def test_observables_hashed_layout(chain12, chain12_streamed):
+    from distributed_matvec_tpu.models.observables import bind_observables
+    op, h, _ = chain12
+    eng = chain12_streamed
+    n = op.basis.number_states
+    psi = _rand_like((n,), np.complex128, 6)
+    psi /= np.linalg.norm(psi)
+    want = float(np.real(psi.conj() @ (h @ psi)))
+    bo = bind_observables([op], eng, mode="fused")[0]
+    got = bo.expectation(eng.to_hashed(psi))
+    assert got == pytest.approx(want, abs=1e-10)
+
+
+def test_observable_complex_sector_native():
+    import jax.numpy as jnp
+
+    from distributed_matvec_tpu.models.observables import bind_observables
+    op = build_heisenberg(8, 4, None, [([*range(1, 8), 0], 1)])
+    op.basis.build()
+    h = dense_effective_matrix(op)
+    eng = LocalEngine(op)
+    n = op.basis.number_states
+    psi = _rand_like((n,), np.complex128, 2)
+    psi /= np.linalg.norm(psi)
+    want = float(np.real(psi.conj() @ (h @ psi)))
+    bo = bind_observables([op], eng)[0]
+    assert bo.expectation(jnp.asarray(psi)) == pytest.approx(
+        want, abs=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# serve integration
+
+
+def test_jobspec_solver_kinds_validate():
+    from distributed_matvec_tpu.serve import JobSpec
+    base = dict(job_id="j", basis={"number_spins": 8, "hamming_weight": 4})
+    s = JobSpec(**base, solver="kpm", n_moments=64)
+    assert s.pricing()["solver"] == "kpm"
+    assert s.pricing()["n_moments"] == 64
+    with pytest.raises(ValueError, match="solver kind"):
+        JobSpec(**base, solver="dmrg")
+    with pytest.raises(ValueError, match="n_moments"):
+        JobSpec(**base, solver="kpm", n_moments=1)
+    with pytest.raises(ValueError, match="t_final"):
+        JobSpec(**base, solver="evolve", t_final=0.0)
+    # solver kind does NOT change the engine key (same warm engine)
+    assert s.engine_key() == JobSpec(**base).engine_key()
+    # round trip
+    s2 = JobSpec.from_json(s.to_json())
+    assert s2.solver == "kpm" and s2.n_moments == 64
+
+
+def test_price_job_prices_dynamics():
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "capacity.py")
+    spec = importlib.util.spec_from_file_location("dmt_capacity_t", path)
+    cap = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cap)
+    rates = {"gather_rows_per_s": 5e8, "flops_per_s": 5e9,
+             "h2d_bytes_per_s": 3e9, "exchange_bytes_per_s": 3e9}
+    base = {"n_states": 1 << 16, "num_terms": 16, "mode": "streamed",
+            "n_devices": 1, "pair": False, "k": 1, "max_iters": 400}
+    p_e = cap.price_job(dict(base), calibration=rates)
+    p_k = cap.price_job(dict(base, solver="kpm", n_moments=256,
+                             n_vectors=4), calibration=rates)
+    p_v = cap.price_job(dict(base, solver="evolve", t_final=4.0,
+                             krylov_dim=24), calibration=rates)
+    assert p_e["priced"] and p_k["priced"] and p_v["priced"]
+    # kpm: ceil(256/2)*4 + bounds columns; evolve: steps*m*2
+    assert p_k["est_iters"] == 128 * 4 + cap.KPM_BOUNDS_COLUMNS
+    assert p_v["est_iters"] == int(np.ceil(
+        cap.EVOLVE_STEPS_PER_UNIT_TIME * 4.0)) * 24 * 2
+    for p in (p_k, p_v):
+        assert p["est_solve_s"] is not None and p["est_solve_s"] > 0
+    # moment/step budgets actually move the price
+    p_k2 = cap.price_job(dict(base, solver="kpm", n_moments=512,
+                              n_vectors=4), calibration=rates)
+    assert p_k2["est_solve_s"] > p_k["est_solve_s"]
+
+
+def test_scheduler_runs_dynamics_jobs():
+    """End-to-end: kpm + evolve + eigs jobs of ONE basis drain through
+    the scheduler sharing ONE warm engine; dynamics jobs run one per
+    batch, results carry their kind-specific fields."""
+    from distributed_matvec_tpu.serve import (EnginePool, JobQueue,
+                                              JobSpec, Scheduler)
+    basis = {"number_spins": 10, "hamming_weight": 5}
+    queue, pool = JobQueue(), EnginePool()
+    sched = Scheduler(queue=queue, pool=pool, rates=None, block_width=4)
+    specs = [
+        JobSpec(job_id="eig0", basis=dict(basis), k=1, tol=1e-9,
+                max_iters=200),
+        JobSpec(job_id="kpm0", basis=dict(basis), solver="kpm",
+                n_moments=48, n_vectors=2),
+        JobSpec(job_id="ev0", basis=dict(basis), solver="evolve",
+                t_final=0.5, krylov_dim=12, tol=1e-10),
+    ]
+    for s in specs:
+        sched.submit(s)
+    n_done = sched.drain(scan_spool=False)
+    assert n_done == 3
+    assert pool.builds == 1 and pool.hits == 2, (pool.builds, pool.hits)
+    rk = queue.result("kpm0")
+    assert rk["status"] == "done" and rk["solver"] == "kpm"
+    assert len(rk["moments_head"]) == 8
+    assert rk["moments_head"][0] == pytest.approx(1.0)
+    rv = queue.result("ev0")
+    assert rv["status"] == "done" and rv["solver"] == "evolve"
+    assert rv["converged"] and rv["norm_drift"] < 1e-11
+    re_ = queue.result("eig0")
+    assert re_["status"] == "done" and re_["eigenvalues"]
+
+
+def test_scheduler_packs_dynamics_singly():
+    from distributed_matvec_tpu.serve import JobQueue, JobSpec, Scheduler
+    basis = {"number_spins": 8, "hamming_weight": 4}
+    queue = JobQueue()
+    sched = Scheduler(queue=queue, rates=None, block_width=4)
+    for i in range(3):
+        queue.submit(JobSpec(job_id=f"k{i}", basis=dict(basis),
+                             solver="kpm", n_moments=16,
+                             submit_ts=float(i + 1)))
+    batch = sched.next_batch()
+    assert len(batch) == 1 and batch[0].job_id == "k0"
+
+
+# ---------------------------------------------------------------------------
+# the REAL 2-process leg
+
+
+def test_multihost_dynamics_two_ranks(tmp_path):
+    """2-process run (multihost worker harness, dynamics leg):
+    rank-local streamed engines drive KPM + evolve on both ranks; the
+    printed moment/energy agree across ranks to full precision and each
+    rank built exactly ONE engine for both solvers."""
+    import importlib.util
+    import socket
+    import subprocess
+
+    rep_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "obs_report.py")
+    spec = importlib.util.spec_from_file_location("obs_report_dyn",
+                                                  rep_path)
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    run = tmp_path / "dyn_run"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_DYN"] = "1"
+    env["DMT_OBS_DIR"] = str(run)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    mu1, e0 = {}, {}
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
+        for line in out.splitlines():
+            if line.startswith(f"[p{pid}] DYN_MU1 "):
+                mu1[pid] = float(line.split()[-1])
+            if line.startswith(f"[p{pid}] DYN_E "):
+                e0[pid] = float(line.split()[-1])
+    # identical rank-local problems: cross-rank agreement to the bit
+    assert mu1[0] == mu1[1], mu1
+    assert e0[0] == e0[1], e0
+    events = rep.load_events(str(run))
+    for r in (0, 1):
+        inits = [e for e in events if e["rank"] == r
+                 and e["kind"] == "engine_init"]
+        assert len(inits) == 1, [e.get("engine") for e in inits]

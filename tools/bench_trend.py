@@ -69,6 +69,9 @@ METRIC_WHITELIST = (
     "serve_p99_latency_ms", "serve_engine_builds", "serve_engine_hits",
     "serve_batch_speedup", "serve_e0_max_rel_err", "solo_wall_s",
     "resume_reshard_s", "resume_rebuild_plan_s",
+    "kpm_moments_per_s", "kpm_dos_rel_err", "kpm_n_moments",
+    "kpm_apply_ms", "evolve_steps_per_s", "evolve_norm_drift",
+    "evolve_energy_drift", "evolve_steps",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -110,7 +113,13 @@ DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "pipelined_steady_apply_ms",
                 "hybrid_plan_bytes", "hybrid_steady_apply_ms",
                 "serve_solves_per_min", "serve_p99_latency_ms",
-                "resume_reshard_s", "resume_rebuild_plan_s")
+                "resume_reshard_s", "resume_rebuild_plan_s",
+                # dynamics throughputs (DESIGN.md §29; both
+                # higher-is-better via the shared direction table):
+                # a PR that quietly slows the KPM moment recurrence or
+                # the Krylov evolution step loop fails the gate even
+                # when raw apply walls hold
+                "kpm_moments_per_s", "evolve_steps_per_s")
 
 #: Absolute noise floors per gated metric: a baseline below the floor is
 #: scheduler jitter, not a trajectory (``barrier_ms`` on a healthy

@@ -440,6 +440,20 @@ def plan(n_states: int, num_terms: int, T0: int, pair: bool,
 #: trend record wins once the service has run the config.
 EST_COLUMNS_PER_EIGENPAIR = 48
 
+#: Dynamics solve-length models (DESIGN.md §29), in the same matvec-
+#: COLUMN units the eigensolver model uses, so every solver kind prices
+#: through the one calibrated `est ms/apply` rate:
+#:  * kpm — the doubling recurrence takes ~n_moments/2 block applies of
+#:    n_vectors columns each, plus the spectral-bounds Lanczos pass;
+#:  * evolve — ~EVOLVE_STEPS_PER_UNIT_TIME accepted steps per unit
+#:    time at the default tolerance, each step krylov_dim applies of a
+#:    2-column (Re, Im) block.
+#: Documented model constants with the same standing as
+#: EST_COLUMNS_PER_EIGENPAIR — the measured trend record wins once the
+#: service has run the config.
+KPM_BOUNDS_COLUMNS = 64
+EVOLVE_STEPS_PER_UNIT_TIME = 8
+
 
 def price_job(spec, calibration: Optional[dict] = None,
               hbm_gb: float = 16.0, host_ram_gb: float = 64.0,
@@ -487,8 +501,23 @@ def price_job(spec, calibration: Optional[dict] = None,
                 "reason": f"unknown engine mode {mode!r}"}
     fits = bool(entry["fits_n_states"])
     est_apply_ms = entry.get("est_apply_ms")
-    est_iters = min(EST_COLUMNS_PER_EIGENPAIR * k,
-                    int(spec.get("max_iters") or 10 ** 9))
+    solver = str(spec.get("solver") or "eigs")
+    if solver == "kpm":
+        # moment recurrence: ceil(n_moments/2) block applies of
+        # n_vectors columns, plus the bounds pass
+        est_iters = (int(spec.get("n_moments") or 256) + 1) // 2 \
+            * max(int(spec.get("n_vectors") or 4), 1) + KPM_BOUNDS_COLUMNS
+    elif solver == "evolve":
+        # trajectory: steps/unit-time x krylov applies x the 2-column
+        # (Re, Im) block a complex state rides on a real engine
+        import math as _math
+        steps = max(int(_math.ceil(
+            EVOLVE_STEPS_PER_UNIT_TIME * float(spec.get("t_final") or 1.0))),
+            1)
+        est_iters = steps * max(int(spec.get("krylov_dim") or 24), 2) * 2
+    else:
+        est_iters = min(EST_COLUMNS_PER_EIGENPAIR * k,
+                        int(spec.get("max_iters") or 10 ** 9))
     # 6 decimals: a sub-millisecond solve must price > 0, or a long
     # queue of tiny jobs would never grow the admission backlog
     est_solve_s = (round(est_apply_ms * est_iters / 1e3, 6)
