@@ -337,7 +337,14 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     with the rig's calibration state) and records ``hybrid_plan_bytes``
     / ``hybrid_steady_apply_ms`` / ``hybrid_stream_term_fraction`` /
     ``hybrid_bit_identical`` (vs the streamed leg) — the first two join
-    the default trend-gate set."""
+    the default trend-gate set.  The sixth leg runs the AUTOTUNED
+    streamed engine (DESIGN.md §30; ``tune=static`` — the calibrated
+    search picks every knob, no hand-set values) and records
+    ``autotuned_steady_apply_ms`` / ``tune_search_s`` /
+    ``tuned_config`` / ``best_hand_steady_apply_ms`` (the cheapest
+    hand-set streamed-family leg, the bar the tuned config must meet),
+    with bit-identity against fused riding along — the autotuner only
+    ever picks value-exact knobs."""
     import jax
 
     from distributed_matvec_tpu.obs.metrics import histogram as _hist
@@ -365,21 +372,26 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     y_stream = None
     cfg = get_config()
     saved_tier = cfg.stream_compress
+    saved_tune = cfg.tune
     # every leg pins its pipeline depth explicitly so the recorded
-    # numbers keep their identity regardless of ambient DMT_PIPELINE
+    # numbers keep their identity regardless of ambient DMT_PIPELINE;
+    # the autotuned leg instead leaves EVERY knob unset (depth None,
+    # compress at its default) so the §30 search owns them all
     legs = (("fused", None, 0), ("streamed", "off", 0),
             ("compressed", compress_tier, 0), ("pipelined", "off", 4),
-            ("hybrid", "off", 0))
+            ("hybrid", "off", 0), ("autotuned", "off", None))
     try:
         for leg, tier, pipe_depth in legs:
             mode = leg if leg in ("fused", "hybrid") else "streamed"
+            cfg.tune = "static" if leg == "autotuned" else "off"
             if tier is not None:
                 cfg.stream_compress = tier
             _progress(f"{name}: {leg} engine"
                       + (f" (stream_compress={tier})"
                          if leg == "compressed" else "")
                       + (f" (pipeline_depth={pipe_depth})"
-                         if leg == "pipelined" else ""))
+                         if leg == "pipelined" else "")
+                      + (" (tune=static)" if leg == "autotuned" else ""))
             t0 = time.perf_counter()
             # the pipelined leg keeps the default chunking (bit-identity
             # to fused requires the SAME chunk/accumulation order): on a
@@ -460,6 +472,27 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
                     if frac:
                         out["overlap_fraction"] = round(
                             sum(frac) / len(frac), 4)
+            elif leg == "autotuned":
+                # the self-tuning leg (DESIGN.md §30): assert the tuned
+                # config's bit-identity to fused (value-exact knobs
+                # only), and record what the search chose and cost —
+                # best_hand_steady_apply_ms is the bar the acceptance
+                # gate compares autotuned_steady_apply_ms against
+                out["autotuned_bit_identical"] = bool(
+                    np.array_equal(y_ref, np.asarray(yh)))
+                tev = [e for e in obs.events("tune_config")
+                       if e.get("engine") == "distributed"
+                       and e.get("mode") == "streamed"]
+                if tev:
+                    out["tuned_config"] = str(tev[-1].get("token"))
+                    out["tune_search_s"] = float(
+                        tev[-1].get("search_s") or 0.0)
+                    out["tuned_source"] = str(tev[-1].get("source"))
+                hand = [out.get(f"{lg}_steady_apply_ms")
+                        for lg in ("streamed", "compressed", "pipelined")]
+                hand = [h for h in hand if h is not None]
+                if hand:
+                    out["best_hand_steady_apply_ms"] = round(min(hand), 3)
             elif leg == "hybrid":
                 # the per-term split leg (DESIGN.md §28): auto split
                 # priced off the resolved calibration, bit-identity
@@ -493,6 +526,10 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
             _progress(f"{name}: {leg} steady {steady_ms:.2f} ms/apply")
     finally:
         cfg.stream_compress = saved_tier
+        cfg.tune = saved_tune
+    out["autotuned_steady_speedup"] = round(
+        out["fused_steady_apply_ms"]
+        / max(out["autotuned_steady_apply_ms"], 1e-9), 2)
     out["stream_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
         / max(out["streamed_steady_apply_ms"], 1e-9), 2)

@@ -28,6 +28,14 @@ through to the default; ``hybrid_stream_term_fraction`` rides the
 trend as CONTEXT (which side of the priced split the terms landed on),
 not a gated direction — neither growth nor shrinkage is a regression
 per se, the priced split is whatever the rates make it.
+
+The autotuner's metrics (``make tune-check``, DESIGN.md §30) register
+the same way: ``autotuned_steady_apply_ms``, ``tune_search_s`` and
+``best_hand_steady_apply_ms`` are cost-like — the tuned leg's wall, the
+knob search's own cost, or the hand-set bar growing is the regression —
+and deliberately fall through to the default;
+``autotuned_steady_speedup`` carries the ``speedup`` tag, so shrinkage
+gates as the regression under the existing rule.
 """
 
 from __future__ import annotations

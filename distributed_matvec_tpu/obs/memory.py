@@ -477,6 +477,12 @@ def remediation(context: dict) -> List[str]:
     engine = str(context.get("engine", ""))
     phase = str(context.get("phase", ""))
     out = []
+    if mode in ("streamed", "hybrid"):
+        out.append(
+            "set tune=static (DMT_TUNE=static): the autotuner prices the "
+            "row-chunk / compress / pipeline / plan-tier cross-product "
+            "against the calibrated roofline and picks the cheapest "
+            "feasible config — usually the right knobs without hand-tuning")
     if mode in ("ell", "compact"):
         out.append(
             "switch to mode='streamed' (DistributedEngine): the routing "

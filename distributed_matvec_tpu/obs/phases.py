@@ -56,6 +56,8 @@ __all__ = [
     "phases_enabled",
     "zero_counts",
     "emit_apply_phases",
+    "emit_tune_config",
+    "emit_retune",
 ]
 
 #: Flops charged per group element of the fused orbit scan (coset-walk
@@ -158,3 +160,37 @@ def emit_apply_phases(engine: str, mode: str, apply_index: int,
                               if isinstance(v, float) else v)
                           for k, v in pipeline.items()}
     return emit("apply_phases", **ev)
+
+
+def emit_tune_config(engine: str, mode: str, config: dict, token: str,
+                     priced_ms: float, source: str, search_s: float,
+                     fingerprint: str) -> Optional[dict]:
+    """One autotune decision (DESIGN.md §30): the knob config an engine
+    build adopted, where it came from (``search`` | ``artifact`` |
+    ``retune``), its roofline price, and what the search cost.  Rides
+    the obs switch only — tune events are build-time bookkeeping, not
+    per-apply work, so the ``phases`` knob does not gate them."""
+    if not obs_enabled():
+        return None
+    return emit("tune_config", engine=str(engine), mode=str(mode),
+                config=dict(config), token=str(token),
+                priced_ms=round(float(priced_ms), 4), source=str(source),
+                search_s=round(float(search_s), 6),
+                fingerprint=str(fingerprint))
+
+
+def emit_retune(engine: str, mode: str, apply_index: int,
+                old_token: str, new_token: str, ratio: float,
+                priced_ms: float, rebuild_s: float) -> Optional[dict]:
+    """One drift-triggered re-tune applied at a safe boundary: the
+    measured/priced ``ratio`` that tripped ``tune/live.DRIFT_BAND``, the
+    old and new knob tokens, and what the boundary re-key cost.  The
+    ``obs_report roofline`` console renders these rows so an operator
+    sees *when* the runtime re-decided, not just that walls changed."""
+    if not obs_enabled():
+        return None
+    return emit("retune", engine=str(engine), mode=str(mode),
+                apply=int(apply_index), old_token=str(old_token),
+                new_token=str(new_token), ratio=round(float(ratio), 4),
+                priced_ms=round(float(priced_ms), 4),
+                rebuild_s=round(float(rebuild_s), 4))

@@ -477,6 +477,32 @@ def roofline_report(events: List[dict],
         grp["overlap_below_estimate"] = bool(
             priced_overlap >= 0.02 * wall_b
             and measured_overlap < 0.5 * priced_overlap)
+    # autotuner rows (DESIGN.md §30): the chosen configs and any
+    # drift-triggered re-tunes this run recorded, plus a per-group
+    # priced-vs-tuned-vs-measured triple — "priced" is the calibrated
+    # bound of the structural counts (roofline_fraction's numerator),
+    # "tuned" the search's pre-build estimate for the adopted config,
+    # "measured" the steady apply wall
+    tune_cfgs = [e for e in events if e.get("kind") == "tune_config"]
+    retunes = [e for e in events if e.get("kind") == "retune"]
+    if tune_cfgs or retunes:
+        out["tuning"] = {
+            "configs": [{k: e.get(k) for k in
+                         ("engine", "mode", "token", "priced_ms",
+                          "source", "search_s")} for e in tune_cfgs],
+            "retunes": [{k: e.get(k) for k in
+                         ("engine", "mode", "apply", "old_token",
+                          "new_token", "ratio", "priced_ms",
+                          "rebuild_s")} for e in retunes],
+        }
+        for key, grp in out["groups"].items():
+            eng_mode = key.split("+pipe", 1)[0]
+            match = [e for e in tune_cfgs
+                     if f"{e.get('engine')}/{e.get('mode')}" == eng_mode]
+            if match:
+                grp["tuned_token"] = str(match[-1].get("token"))
+                grp["tuned_priced_ms"] = float(
+                    match[-1].get("priced_ms") or 0.0)
     return out
 
 
@@ -533,6 +559,12 @@ def print_roofline(report: dict) -> None:
               f"(phase {grp['binding_phase']}"
               + (f", run at {frac:.1%} of the combined roofline)"
                  if frac is not None else ")"))
+        if grp.get("tuned_priced_ms") is not None:
+            bound = sum(a["bound_ms"] for a in grp["phases"].values())
+            print(f"  priced vs tuned vs measured: bound {bound:.4f} ms | "
+                  f"tuned {grp['tuned_priced_ms']:.4f} ms "
+                  f"[{grp['tuned_token']}] | measured "
+                  f"{grp['wall_ms']:.4f} ms")
         if grp.get("mean_chunk_stall_ms") is not None:
             print(f"  mean plan-stream chunk stall: "
                   f"{grp['mean_chunk_stall_ms']:.4f} ms")
@@ -563,3 +595,17 @@ def print_roofline(report: dict) -> None:
             print(f"  pipelined-apply estimate: overlap exchange with chunk "
                   f"compute saves {grp['pipelined_overlap_ms']:.3f} ms "
                   f"-> {grp['pipelined_speedup_estimate']:.2f}x")
+    tuning = report.get("tuning")
+    if tuning:
+        print("\ntuning:")
+        for c in tuning.get("configs", []):
+            print(f"  {c['engine']}/{c['mode']}: {c['token']} "
+                  f"priced {float(c['priced_ms'] or 0.0):.4f} ms "
+                  f"[{c['source']}]"
+                  + (f" (search {float(c['search_s']):.2f} s)"
+                     if c.get("search_s") else ""))
+        for r in tuning.get("retunes", []):
+            print(f"  retune {r['engine']}/{r['mode']} @ apply "
+                  f"{r['apply']}: {r['old_token']} -> {r['new_token']} "
+                  f"(measured/priced {float(r['ratio']):.2f}x, rebuilt in "
+                  f"{float(r['rebuild_s']):.2f} s)")

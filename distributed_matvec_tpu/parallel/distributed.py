@@ -253,7 +253,9 @@ class _PlanPrefetcher:
 
     One worker when the plan lives on the DISK tier (h5py handles are not
     thread-safe — reads stay serialized, the CRC check + retry backoff
-    still overlap compute); ``min(depth, 4)`` workers for the RAM tier.
+    still overlap compute); ``min(depth, 4)`` workers for the RAM tier,
+    unless the autotuner priced a specific ``prefetch_workers`` count
+    (DESIGN.md §30), which then bounds it.
     Workers NEVER run the corrupt-chunk degrade path (it can dispatch
     collective build programs and mutate the engine's plan state): a read
     failure is delivered as a ``degrade`` marker and the consumer repairs
@@ -271,8 +273,9 @@ class _PlanPrefetcher:
         self._consumed = int(start) - 1
         self._next = int(start)
         self._stop = False
+        tuned_w = getattr(eng, "_tune_workers", None)
         n_workers = 1 if eng._plan_disk is not None \
-            else min(self._depth, 4)
+            else min(tuned_w or self._depth, self._depth, 4)
         self._threads = [
             threading.Thread(target=self._work, daemon=True,
                              name=f"dmt-plan-prefetch-{k}")
@@ -512,6 +515,34 @@ class DistributedEngine:
         self._n_my_shards = sum(
             1 for d in range(D) if self._shard_addressable(d))
 
+        # -- self-tuning runtime (DESIGN.md §30) ---------------------------
+        #: the adopted knob config (tune/space.TunedConfig) when
+        #: tune=static|live; the live controller; and a re-tune proposal
+        #: awaiting the next safe boundary (the top of an apply, or a
+        #: serve-pool acquire — NEVER mid-apply).  Tuned knobs flow into
+        #: the plan through the SAME fields a hand-set engine uses
+        #: (batch_size, codec tier, hybrid token), so the fingerprint —
+        #: and therefore the sidecar and bit-identity story — is
+        #: identical to hand-setting the same values.
+        self._tuned = None
+        self._tuner = None
+        self._retune_pending = None
+        self._tune_cal: Optional[dict] = None
+        self._tune_compress: Optional[str] = None
+        self._tune_hybrid_split = None
+        self._tune_workers: Optional[int] = None
+        self._tune_plan_tier: Optional[str] = None
+        tune_knob = str(cfg.tune).strip().lower() or "off"
+        if tune_knob not in ("off", "0", "false", "no", "static", "live"):
+            raise ValueError(
+                f"unknown tune setting {cfg.tune!r}: pick off | static | "
+                "live (DMT_TUNE / config.tune)")
+        self._tune_mode = tune_knob \
+            if (tune_knob in ("static", "live")
+                and mode in ("streamed", "hybrid")) else "off"
+        if self._tune_mode != "off":
+            self._init_autotune(batch_size, pipeline_depth, hybrid_split)
+
         # Row provider for the plan builds: this process's shards come from
         # the rows already loaded above; PEER shards are fetched on demand
         # (shard-file read, or a view of the global layout) one at a time —
@@ -698,8 +729,15 @@ class DistributedEngine:
                 if self._compress not in _PC.TIERS:
                     raise ValueError(
                         f"unknown stream_compress tier "
-                        f"{cfg.stream_compress!r}; pick one of "
+                        f"{cfg.stream_compress!r}; set tune=static "
+                        "(DMT_TUNE=static) to let the autotuner pick a "
+                        "value-exact tier, or pick one of "
                         f"{'|'.join(_PC.TIERS)}")
+                if self._tune_compress is not None:
+                    # the autotuner's tier (off|lossless only — both
+                    # value-exact); a hand-pinned DMT_STREAM_COMPRESS or
+                    # non-default config value was never overridden above
+                    self._compress = self._tune_compress
                 sk = str(cfg.stream_kernel).strip().lower() or "auto"
                 if sk not in ("auto", "xla", "pallas"):
                     raise ValueError(
@@ -723,7 +761,9 @@ class DistributedEngine:
                 if mode == "hybrid":
                     if self._compress == "off":
                         self._codec_tier = "lossless"
-                    self._init_hybrid_policy(hybrid_split)
+                    self._init_hybrid_policy(
+                        hybrid_split if hybrid_split is not None
+                        else self._tune_hybrid_split)
                 stream_cache = self._resolve_structure_cache(structure_cache)
                 self.structure_restored = agree_restored(
                     self._try_load_stream_plan(stream_cache))
@@ -1859,15 +1899,23 @@ class DistributedEngine:
         if s not in ("auto", "all-stream", "all-recompute") \
                 and not s.startswith("stream:"):
             raise ValueError(
-                f"bad hybrid split {s!r}: pick auto | all-stream | "
-                "all-recompute | stream:<term,term,...> "
-                "(DMT_HYBRID / config.hybrid)")
+                f"bad hybrid split {s!r}: set tune=static "
+                "(DMT_TUNE=static) to let the autotuner pick the split, "
+                "or pick auto | all-stream | all-recompute | "
+                "stream:<term,term,...> (DMT_HYBRID / config.hybrid)")
         self._hybrid_split = s
         self._static_hybrid_mask()      # explicit lists validate eagerly
         self._hybrid_cal = None
         if s == "auto":
-            from ..obs import roofline as _roofline
-            self._hybrid_cal = _roofline.resolve_calibration()
+            # the autotuner's rates win when tuning is on: under
+            # tune=live that is the refined posterior, so a drift-driven
+            # re-tune RE-KEYS the split through the same rate-bearing
+            # fingerprint token a re-calibration would (DESIGN.md §28/§30)
+            cal = getattr(self, "_tune_cal", None)
+            if cal is None:
+                from ..obs import roofline as _roofline
+                cal = _roofline.resolve_calibration()
+            self._hybrid_cal = cal
 
     def _hybrid_token(self) -> str:
         """The fingerprint's split token: the policy string, plus — for
@@ -2139,22 +2187,25 @@ class DistributedEngine:
                 saved = sidecar
             if saved:
                 log_debug(f"stream plan checkpointed to {saved}")
-        if self.plan_bytes > cfg.stream_plan_ram_gb * 1e9:
+        if (self.plan_bytes > cfg.stream_plan_ram_gb * 1e9
+                or self._tune_plan_tier == "disk"):
             if saved:
                 D = self.n_devices
                 self._plan_disk = {
                     d: saved for d in range(D) if self._shard_addressable(d)}
                 self._plan_chunks = None
-                log_debug("stream plan beyond stream_plan_ram_gb: host RAM "
-                          "copy dropped, disk tier active")
+                log_debug("stream plan beyond stream_plan_ram_gb (or "
+                          "tuned to the disk tier): host RAM copy "
+                          "dropped, disk tier active")
             else:
                 from ..utils.logging import log_warn
                 log_warn(
                     f"stream plan ({self.plan_bytes / 1e9:.1f} GB) exceeds "
                     "stream_plan_ram_gb but no artifact-cache sidecar is "
                     "available as a disk tier; keeping it in host RAM "
-                    "(enable DMT_ARTIFACT_CACHE or raise "
-                    "DMT_STREAM_PLAN_RAM_GB)")
+                    "(set tune=static to let the autotuner pick a "
+                    "feasible tier/codec, enable DMT_ARTIFACT_CACHE, or "
+                    "raise DMT_STREAM_PLAN_RAM_GB)")
 
     def _try_load_stream_plan(self, path: Optional[str]) -> bool:
         """Restore the plan from a stream sidecar: each rank reads only its
@@ -2269,7 +2320,8 @@ class DistributedEngine:
         self._stream_overflow = scalars["overflow"]
         self._stream_invalid = scalars["invalid"]
         self._plan_files = {}
-        if plan_bytes > get_config().stream_plan_ram_gb * 1e9:
+        if (plan_bytes > get_config().stream_plan_ram_gb * 1e9
+                or self._tune_plan_tier == "disk"):
             self._plan_chunks = None
             self._plan_disk = where
             log_debug(f"stream plan restored on the DISK tier "
@@ -2502,6 +2554,230 @@ class DistributedEngine:
         failure is retried with backoff instead of killing a solve
         mid-apply."""
         return self._stage_with_retries(self._fetch_plan_chunk(ci))
+
+    # -- self-tuning runtime (DESIGN.md §30) -------------------------------
+
+    def _tune_stats(self) -> dict:
+        """The structure geometry the autotuner prices from — everything
+        is an engine fact, nothing is a rate (rates are the search's
+        OTHER input, so the same stats re-price correctly under a
+        refined posterior)."""
+        from ..utils.artifacts import artifacts_enabled
+        cfg = get_config()
+        return {"shard_size": int(self.shard_size),
+                "num_terms": int(self.num_terms),
+                "n_my_shards": int(self._n_my_shards),
+                "n_devices": int(self.n_devices),
+                "pair": bool(self.pair),
+                "cplx": bool(self.pair or not self.real),
+                "columns": 1,
+                "group_order": int(self._hybrid_group_order()),
+                "ram_budget_bytes": float(cfg.stream_plan_ram_gb) * 1e9,
+                "disk_available": bool(artifacts_enabled())}
+
+    def _init_autotune(self, batch_size_arg, pipeline_arg,
+                       hybrid_arg) -> None:
+        """``tune=static|live`` engine-build hook: restore or run the
+        knob search, agree the answer across ranks, and fold the chosen
+        knobs into the build (before any plan exists — the plan is then
+        BUILT at the tuned knobs, so the fingerprint/sidecar/bit-identity
+        story is exactly a hand-set engine's)."""
+        from .. import tune as _tune
+        from ..obs import roofline as _roofline
+        dev = self.mesh.devices.flat[0]
+        plat = dev.platform
+        kind = getattr(dev, "device_kind", plat)
+        prior = None
+        if self._tune_mode == "live":
+            prior = _tune.load_posterior(plat, kind, self.mode)
+        if prior is None:
+            prior = _roofline.resolve_calibration(backend=plat)
+        prior = dict(prior)
+        prior.setdefault("device_kind", kind)
+        stats = self._tune_stats()
+        fp = _tune.tuning_fingerprint(stats, prior, self.mode)
+        chosen = _tune.load_tuned(fp)
+        search_s = 0.0
+        if chosen is None:
+            chosen, search_s = _tune.timed_choose(stats, prior, self.mode)
+            _tune.save_tuned(fp, chosen, stats, prior, search_s)
+        chosen = _tune.agree_config(chosen, self._multi)
+        self._tuned = chosen
+        self._tune_cal = prior
+        self._tune_fp = fp
+        self._apply_tuned_knobs(chosen, batch_size_arg, pipeline_arg,
+                                hybrid_arg)
+        if self._tune_mode == "live":
+            self._tuner = _tune.LiveTuner(self.mode, stats, prior, chosen)
+        obs_phases.emit_tune_config(
+            "distributed", self.mode, chosen.knobs(), chosen.token(),
+            chosen.priced_ms, chosen.source, search_s, fp)
+        log_debug(f"autotune ({self._tune_mode}): {chosen.token()} "
+                  f"priced {chosen.priced_ms:.3f} ms/apply "
+                  f"[{chosen.source}]")
+
+    def _apply_tuned_knobs(self, t, batch_size_arg, pipeline_arg,
+                           hybrid_arg) -> None:
+        """Fold a :class:`~..tune.TunedConfig` into the build with the
+        documented precedence: an explicit constructor argument always
+        wins; a config knob moved off its dataclass default (env var or
+        ``update_config``) is a hand pin and wins; the tuned value fills
+        everything else."""
+        import dataclasses as _dc
+        cfg = get_config()
+        defaults = {f.name: f.default
+                    for f in _dc.fields(type(cfg))}
+        M = self.shard_size
+        if batch_size_arg is None \
+                and cfg.matvec_batch_size == defaults["matvec_batch_size"]:
+            self.batch_size = _round_up(min(int(t.batch_size), M), 8)
+        if pipeline_arg is None \
+                and str(cfg.pipeline) == str(defaults["pipeline"]):
+            self._pipeline_req = int(t.pipeline_depth)
+        if str(cfg.stream_compress) == str(defaults["stream_compress"]):
+            self._tune_compress = t.stream_compress
+        if hybrid_arg is None \
+                and str(cfg.hybrid) == str(defaults["hybrid"]):
+            self._tune_hybrid_split = t.hybrid_split \
+                if t.hybrid_split != "-" else None
+        self._tune_workers = int(t.prefetch_workers) or None
+        self._tune_plan_tier = t.plan_tier
+
+    def _agree_retune(self, prop):
+        """One window-boundary collective: every rank reaches this at
+        the same apply (windows are deterministic in apply count), so
+        the first PROPOSING rank's config is adopted fleet-wide — or the
+        re-tune is dropped everywhere.  One rank re-keying alone would
+        strand the peers in the next ``_plan_stream`` collective, so on
+        any agreement failure the conservative answer is no re-tune on
+        every rank."""
+        if not self._multi:
+            return prop
+        try:
+            from jax.experimental import multihost_utils as mhu
+
+            from ..tune.space import TunedConfig
+            enc = prop.encode() if prop is not None else [0] * 6
+            vec = np.asarray([1 if prop is not None else 0] + enc,
+                             np.int64)
+            rows = np.asarray(
+                mhu.process_allgather(vec)).reshape(-1, vec.size)
+            have = rows[:, 0] == 1
+            if not have.any():
+                return None
+            r = int(np.argmax(have))
+            return TunedConfig.decode(
+                rows[r, 1:], self.mode,
+                priced_ms=prop.priced_ms if prop is not None else 0.0,
+                source="retune")
+        except Exception as e:
+            log_debug(f"retune agreement unavailable ({e!r}); "
+                      "skipping the re-tune on all ranks")
+            return None
+
+    def maybe_retune(self) -> bool:
+        """Apply a pending drift-triggered re-tune NOW — at a safe
+        boundary only (callers: the top of :meth:`matvec` before any
+        device work, and the serve pool between jobs).  The plan is
+        re-keyed exactly like a fresh build at the new knobs: artifact
+        restore first, deterministic rebuild otherwise — never a
+        mid-apply mutation.  Returns True when a re-key happened."""
+        prop = self._retune_pending
+        if prop is None or self.mode not in ("streamed", "hybrid"):
+            return False
+        self._retune_pending = None
+        old = self._tuned
+        ratio = (self._tuner.last_ratio
+                 if self._tuner is not None else 0.0) or 0.0
+        t0 = time.perf_counter()
+        self._tuned = prop
+        M = self.shard_size
+        self.batch_size = _round_up(min(int(prop.batch_size), M), 8)
+        self._pipeline_req = int(prop.pipeline_depth)
+        self._compress = prop.stream_compress
+        self._codec_tier = self._compress
+        if self.mode == "hybrid":
+            if self._compress == "off":
+                self._codec_tier = "lossless"
+            self._tune_hybrid_split = prop.hybrid_split \
+                if prop.hybrid_split != "-" else None
+            if self._tuner is not None:
+                # re-key the auto split at the POSTERIOR rates — the §28
+                # rate-bearing fingerprint token changes with them
+                self._tune_cal = self._tuner.posterior.rates()
+            self._init_hybrid_policy(self._tune_hybrid_split)
+            self._hybrid_mask = None
+        self._tune_workers = int(prop.prefetch_workers) or None
+        self._tune_plan_tier = prop.plan_tier
+        try:
+            self._rebuild_stream_plan()
+        except Exception as e:
+            oom_reraise(e, engine="distributed", mode=self.mode,
+                        phase="retune", n_states=int(self.n_states))
+        if self._tuner is not None:
+            self._tuner.note_rebuild(prop)
+        obs_phases.emit_retune(
+            "distributed", self.mode, self._apply_idx,
+            old.token() if old is not None else "-", prop.token(),
+            ratio, prop.priced_ms, time.perf_counter() - t0)
+        log_debug(f"autotune re-key at apply {self._apply_idx}: "
+                  f"{old.token() if old is not None else '-'} -> "
+                  f"{prop.token()} (ratio {ratio:.2f})")
+        return True
+
+    def _rebuild_stream_plan(self) -> None:
+        """Tear down the streamed/hybrid plan and rebuild it at the
+        CURRENT knobs (row-chunk size, codec tier, hybrid split) — the
+        §30 boundary re-key.  Mirrors the constructor's streamed branch:
+        the re-keyed fingerprint is consulted against the artifact cache
+        first (a re-tune back to previously built knobs restores warm),
+        then the kept row provider rebuilds deterministically."""
+        self._fp_cache = None
+        self._phase_count_cache = {}
+        self._stream_build_prog = None
+        self._plan_repaired = {}
+        self._stream_timeline = []
+        self._plan_disk = None
+        self._capacity = self._fused_capacity()
+        old_files = self._plan_files
+
+        def agree(restored: bool) -> bool:
+            if not self._multi:
+                return restored
+            try:
+                from jax.experimental import multihost_utils as mhu
+                return bool(int(np.min(
+                    mhu.process_allgather(np.int32(restored)))))
+            except Exception as e:
+                log_debug(f"restore agreement unavailable ({e!r}); "
+                          "rebuilding on all ranks")
+                return False
+
+        cache = self._resolve_structure_cache(None)
+        restored = agree(self._try_load_stream_plan(cache))
+        if not restored:
+            self._build_stream_plan(self._row_provider)
+            if self.mode == "hybrid":
+                self._hybrid_mask = self._resolve_hybrid_mask()
+            self._encode_stream_plan()
+            self._save_stream_plan(cache, soft=True)
+        self.structure_restored = restored
+        if self._plan_files is not old_files:
+            # the restore path swaps in a fresh handle dict; the engine's
+            # finalizer tracks the old one — close it and re-register
+            import weakref
+            _close_plan_files(old_files)
+            weakref.finalize(self, _close_plan_files, self._plan_files)
+        self._upload_codec_tables()
+        if self.mode == "hybrid":
+            self._setup_hybrid_recompute()
+        self._register_stream_plan()
+        self.pipeline_depth = self._resolve_pipeline_depth(
+            self._plan_nchunks_v)
+        self._matvec = self._make_streamed_matvec()
+        self._last_program_key = self.mode
+        self._last_capacity = self._capacity
+        self._checked.add(self.mode)
 
     def _resolve_pipeline_depth(self, nchunks: int) -> int:
         """Resolve the ``pipeline_depth`` knob (constructor argument >
@@ -3640,6 +3916,11 @@ class DistributedEngine:
             return self._matvec_body(xh, check)
 
     def _matvec_body(self, xh, check: Optional[bool] = None) -> jax.Array:
+        # §30 safe boundary: a drift-scheduled re-tune lands HERE, before
+        # any of this apply's device work — the plan is never mutated
+        # mid-apply, and the re-key wall never pollutes the apply wall
+        if self._retune_pending is not None:
+            self.maybe_retune()
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()
@@ -3762,6 +4043,19 @@ class DistributedEngine:
                     self._phase_counts(tail_elems), chunks=self._nchunks(),
                     columns=max(k, 1), measured_ms=measured,
                     chunk_timeline=timeline, pipeline=pipe)
+                if self._tuner is not None:
+                    # tune=live: the same walls the phases event records
+                    # feed the rate posterior; a drift past DRIFT_BAND
+                    # comes back as a proposal that waits for the next
+                    # safe boundary.  Window boundaries are deterministic
+                    # in apply count, so every rank joins the agreement
+                    # round at the same apply.
+                    prop = self._tuner.observe(
+                        self._phase_counts(tail_elems), dt_ms, measured)
+                    if self._tuner.window_closed and self._multi:
+                        prop = self._agree_retune(prop)
+                    if prop is not None:
+                        self._retune_pending = prop
         histogram("matvec_apply_ms", engine="distributed").observe(dt_ms)
         return y
 

@@ -163,6 +163,19 @@ class EnginePool:
         if eng is not None:
             self._engines.move_to_end(key)
             self.hits += 1
+            # between-jobs is a safe re-key boundary (DESIGN.md §30): a
+            # live-tuned engine whose drift check proposed new knobs
+            # re-plans HERE, never inside a caller's apply sequence
+            retune = getattr(eng, "maybe_retune", None)
+            if retune is not None:
+                try:
+                    if retune():
+                        # the re-key rebuilt the plan — refresh the
+                        # budget's view of this engine's footprint
+                        self._bytes[key] = engine_bytes(eng)
+                        self._evict(keep=key)
+                except Exception:  # a failed re-key keeps the old plan
+                    pass
             self._event("hit", key)
             return eng
         eng = self._builder(spec)

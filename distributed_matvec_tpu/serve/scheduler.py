@@ -3,8 +3,9 @@
 The unit of work here is a JOB STREAM, not a single solve:
 
 * **Admission** is priced, not guessed: every submission is run through
-  ``tools/capacity.price_job`` (the calibrated roofline rates of PR 7)
-  and gets a verdict — ``accept`` (fits, runs within the accept
+  ``tools/capacity.price_job`` (the calibrated roofline rates of PR 7;
+  when the autotuner has persisted a live-rate posterior for the spec's
+  mode, those LEARNED rates win — DESIGN.md §30) and gets a verdict — ``accept`` (fits, runs within the accept
   horizon), ``queue`` (fits, but the priced backlog puts its start
   beyond the horizon — the verdict carries the ETA), or ``reject``
   (does not fit the device/host budgets at all, or cannot meet its
@@ -99,6 +100,14 @@ class Scheduler:
                     raise               # silently dropped
                 rates = None
         self.rates = rates
+        # the autotuner's persisted state (DESIGN.md §30): live-rate
+        # posteriors override the static calibration inside price_job,
+        # so admission prices what the hardware actually did once a
+        # tuned engine has run — None cleanly when nothing is persisted
+        try:
+            self.tuning = load_capacity_module().load_tuning()
+        except Exception:
+            self.tuning = None
         self._backlog_s = 0.0          # priced est_solve_s of queued work
         self._est_s: Dict[str, float] = {}
 
@@ -128,7 +137,8 @@ class Scheduler:
         pricing["n_devices"] = max(1, min(asked, live))
         price = cap.price_job(pricing, calibration=self.rates,
                               hbm_gb=self.hbm_gb,
-                              host_ram_gb=self.host_ram_gb)
+                              host_ram_gb=self.host_ram_gb,
+                              tuning=self.tuning)
         eta_s = round(self._backlog_s, 3)
         if not price["fits"]:
             verdict = "reject"
@@ -147,7 +157,7 @@ class Scheduler:
                "live_devices": int(live),
                "priced_devices": int(pricing["n_devices"]),
                **{k: price.get(k) for k in
-                  ("est_apply_ms", "est_solve_s", "fits")}}
+                  ("est_apply_ms", "est_solve_s", "fits", "rate_source")}}
         with obs_trace.job_scope(spec.job_id):
             obs_emit("admission", job_id=spec.job_id,
                      engine_key=spec.engine_key(), **{

@@ -16,7 +16,15 @@ products is a pure function of content that rarely changes:
   xla/         the persistent XLA compilation cache (see utils/cache.py),
                shared by every program the engines compile.
 
-All three live under one root (first hit wins):
+Two cheaper-but-still-cacheable decision products ride in the same tree:
+
+  calibration/ measured hardware rates (obs/roofline.py sidecars);
+  tuning/      autotuner decisions (tune/search.py: the chosen knob
+               config per (structure, rates, mode) fingerprint) and the
+               live rate posteriors (tune/live.py, ``*.posterior.json``)
+               that capacity planning and serve admission price from.
+
+All of it lives under one root (first hit wins):
 
   ``DMT_ARTIFACT_DIR`` env var > ``artifact_dir`` config field >
   ``~/.cache/distributed_matvec_tpu/artifacts``
@@ -59,7 +67,8 @@ __all__ = [
 
 def record_cache_event(kind: str, event: str) -> None:
     """One artifact-cache outcome into the metrics registry
-    (``artifact_cache{kind=basis|structure, event=hit|miss|save|evict}``)
+    (``artifact_cache{kind=basis|structure|tuning,
+    event=hit|miss|save|evict}``)
     — the single call site engines and this module share, so the report
     tooling's hit-rate math cannot drift from the recording."""
     from ..obs.metrics import counter

@@ -128,6 +128,23 @@ class RuntimeConfig:
     #   controlled experiments).  The resolved split is baked into the
     #   engine fingerprint (v4), so each split mix compiles and caches
     #   as its own static program
+    tune: str = "off"                      # self-tuning runtime (DMT_TUNE,
+    #   DESIGN.md §30): "off" (every knob is hand-set — all prior
+    #   behavior), "static" (at streamed/hybrid engine build, price the
+    #   full knob cross-product — row-chunk size × pipeline depth ×
+    #   stream_compress tier × hybrid split × prefetch workers ×
+    #   plan RAM/disk tier — through the calibrated roofline and take
+    #   the argmin; the choice is allgather-agreed across ranks, stamped
+    #   into the engine fingerprint via the knobs it sets, and cached as
+    #   a content-addressed tuning artifact so repeat builds skip the
+    #   search), "live" (static, plus each apply window's measured phase
+    #   walls refine a per-(device kind, mode) rate posterior; when
+    #   measured-vs-priced drifts outside tune/live.DRIFT_BAND the
+    #   engine re-tunes at the next safe boundary — never mid-apply).
+    #   Only bit-identity-preserving knob values are ever auto-selected
+    #   (compress off|lossless, order-preserving pipeline depths), and
+    #   explicitly passed constructor/config knobs always win over tuned
+    #   ones.  DMT_TUNE_WINDOW overrides the live update window (8)
     stream_kernel: str = "auto"            # compressed-chunk decode path
     #   (DMT_STREAM_KERNEL): "auto" (currently = xla), "xla" (decode ops
     #   traced into the chunk program — XLA fuses unpack+gather+multiply+

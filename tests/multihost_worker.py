@@ -278,6 +278,43 @@ if os.environ.get("DMT_MH_DYN"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_TUNE"):
+    # Autotune leg (tests/test_autotune.py, DESIGN.md §30): a tune=static
+    # streamed engine per rank over a RANK-LOCAL mesh (the CPU backend
+    # cannot run cross-process computations — same constraint as every
+    # fast leg here) inside a real 2-process jax.distributed job.  Each
+    # rank runs the same deterministic knob search, then the engine's
+    # agree_config allgather adopts rank 0's row — the parent asserts
+    # both ranks PRINT the same tuned token, so the fleet can never
+    # split into two programs.  Bit-identity of the tuned apply against
+    # an untuned streamed engine rides along (the tuner only picks
+    # value-exact knobs), and correctness is still asserted against the
+    # host truth so a broken tuned plan cannot masquerade as agreement.
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.utils.config import update_config
+
+    update_config(tune="static")
+    eng_t = DistributedEngine(op,
+                              mesh=make_mesh(devices=jax.local_devices()),
+                              mode="streamed")
+    update_config(tune="off")
+    assert eng_t._tuned is not None
+    token = eng_t._tuned.token()
+    eng_s = DistributedEngine(op,
+                              mesh=make_mesh(devices=jax.local_devices()),
+                              mode="streamed")
+    yt = np.asarray(eng_t.matvec(eng_t.to_hashed(x)))
+    ys = np.asarray(eng_s.matvec(eng_s.to_hashed(x)))
+    assert np.array_equal(yt, ys), "tuned engine lost bit-identity"
+    err = float(np.abs(eng_t.from_hashed(eng_t.matvec(
+        eng_t.to_hashed(x))) - want).max())
+    print(f"[p{pid}] tune leg: {token} max err {err:.3e}", flush=True)
+    assert err < 1e-12, err
+    print(f"[p{pid}] TUNE_CONFIG {token}", flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_FAST"):
     # Trimmed leg for the cross-rank OBSERVABILITY test: one ell engine
     # per rank over a RANK-LOCAL mesh (all engine collectives stay

@@ -64,6 +64,9 @@ METRIC_WHITELIST = (
     "hybrid_plan_bytes", "hybrid_steady_apply_ms",
     "hybrid_steady_speedup", "hybrid_stream_term_fraction",
     "hybrid_bit_identical",
+    "autotuned_steady_apply_ms", "autotuned_steady_speedup",
+    "tune_search_s", "best_hand_steady_apply_ms",
+    "autotuned_bit_identical",
     "serve_jobs", "serve_jobs_done", "serve_wall_s",
     "serve_solves_per_min", "serve_p50_latency_ms",
     "serve_p99_latency_ms", "serve_engine_builds", "serve_engine_hits",
@@ -106,11 +109,16 @@ METRIC_WHITELIST = (
 #: a PR that quietly streams terms the split priced as recompute (bytes
 #: creep back up) or slows the merged chunk program fails the gate even
 #: when the pure tiers hold.
+#: ``autotuned_steady_apply_ms`` (cost-like) guards the §30 closed loop:
+#: a PR that degrades the search's pick — a pricing-model skew, a knob
+#: grid hole, a posterior that walks rates the wrong way — shows up as
+#: the tuned leg's wall creeping above its trend baseline even when
+#: every hand-set leg holds.
 DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 "compressed_steady_apply_ms", "compress_ratio",
                 "lanczos_iters_per_s", "compress_rel_err",
                 "compress_drift_max", "barrier_ms",
-                "pipelined_steady_apply_ms",
+                "pipelined_steady_apply_ms", "autotuned_steady_apply_ms",
                 "hybrid_plan_bytes", "hybrid_steady_apply_ms",
                 "serve_solves_per_min", "serve_p99_latency_ms",
                 "resume_reshard_s", "resume_rebuild_plan_s",

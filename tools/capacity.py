@@ -234,6 +234,86 @@ def hybrid_split_model(n_states: int, num_terms: int, pair: bool,
             "group_order": max(int(group_order), 1)}
 
 
+#: The rate fields an overlay must carry to replace a calibration in the
+#: pricing paths (mirrors ``obs/roofline.RATE_FIELDS`` without the import).
+TUNE_RATE_FIELDS = ("gather_rows_per_s", "h2d_bytes_per_s",
+                    "exchange_bytes_per_s", "flops_per_s")
+
+
+def load_tuning(backend: Optional[str] = None,
+                device_kind: Optional[str] = None) -> Optional[dict]:
+    """The tune/ subsystem's persisted state (DESIGN.md §30): live-rate
+    posteriors per mode plus the most recent tuned-config artifact per
+    mode.  What ``--tuning`` (and the serve scheduler) folds into
+    admission pricing — the posterior's LEARNED rates replace the static
+    calibration, and each tuned config becomes a candidate row the
+    recommendation can prefer over the catalog modes.  None when the
+    tune package is unavailable or nothing has been persisted."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from distributed_matvec_tpu import tune as _tune
+    except ImportError:
+        return None
+    out = {"rates": {}, "configs": []}
+    for mode in ("streamed", "hybrid"):
+        try:
+            post = _tune.load_posterior(backend, device_kind, mode)
+        except Exception:
+            post = None
+        if post and all(post.get(k) for k in TUNE_RATE_FIELDS):
+            out["rates"][mode] = post
+        try:
+            docs = _tune.find_tuned(mode, backend)
+        except Exception:
+            docs = []
+        if docs:
+            out["configs"].append(docs[0])
+    return out if (out["rates"] or out["configs"]) else None
+
+
+def tuning_report(tuning: dict, rates: Optional[dict]) -> dict:
+    """The report's ``tuning`` section: each persisted tuned config
+    re-priced under the effective rates (posterior when one exists —
+    falling back to the artifact's save-time price), plus the posterior
+    provenance per mode."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from distributed_matvec_tpu import tune as _tune
+
+    rows = []
+    for doc in tuning.get("configs", []):
+        try:
+            cfg = _tune.TunedConfig.from_dict(doc["config"])
+            ms = cfg.priced_ms
+            if rates and all(rates.get(k) for k in TUNE_RATE_FIELDS):
+                try:
+                    # artifact stats are canonicalized (floats as .6g
+                    # strings) — decode before re-pricing
+                    stats = {}
+                    for k, v in (doc.get("stats") or {}).items():
+                        if isinstance(v, str):
+                            f = float(v)
+                            v = int(f) if f.is_integer() else f
+                        stats[k] = v
+                    ms = _tune.price_config(stats, cfg, rates)
+                except Exception:
+                    pass
+            rows.append({
+                "mode": str(doc.get("mode")), "token": cfg.token(),
+                "est_apply_ms": (round(float(ms), 3)
+                                 if ms is not None else None),
+                "rate_source": str((rates or {}).get(
+                    "source", doc.get("rate_source", ""))),
+                "fingerprint": str(doc.get("fingerprint", ""))[:12]})
+        except Exception:
+            continue
+    return {"rows": rows,
+            "posteriors": {m: {"source": r.get("source"),
+                               "n_updates": int(r.get("n_updates") or 0)}
+                           for m, r in tuning.get("rates", {}).items()}}
+
+
 def load_rate_calibration(path: Optional[str] = None) -> Optional[dict]:
     """The measured-rates calibration sidecar ``tools/gather_bound.py``
     persists (``obs/roofline.py``) — explicit path, else the
@@ -458,7 +538,7 @@ EVOLVE_STEPS_PER_UNIT_TIME = 8
 def price_job(spec, calibration: Optional[dict] = None,
               hbm_gb: float = 16.0, host_ram_gb: float = 64.0,
               utilization: float = DEFAULT_UTILIZATION,
-              vectors: int = 3) -> dict:
+              vectors: int = 3, tuning: Optional[dict] = None) -> dict:
     """Admission pricing for ONE job spec — the importable API the solve
     service's scheduler (``distributed_matvec_tpu/serve/scheduler.py``)
     and its tests call instead of shelling out to the CLI.
@@ -468,6 +548,9 @@ def price_job(spec, calibration: Optional[dict] = None,
     ``JobSpec.pricing()`` produces.  ``calibration`` is a rates dict from
     :func:`load_rate_calibration` (or any mapping with
     ``gather_rows_per_s`` etc.); None prices memory fits only.
+    ``tuning`` is a :func:`load_tuning` record: when it carries a live
+    posterior for the spec's mode, THOSE learned rates price the job —
+    admission tracks what the hardware actually did, not the catalog.
 
     Returns ``{est_apply_ms, est_solve_s, fits, est_iters, reason}``:
     ``fits`` is the memory verdict for the spec's mode on its mesh (the
@@ -485,6 +568,13 @@ def price_job(spec, calibration: Optional[dict] = None,
                 "est_iters": None, "priced": False,
                 "reason": "unpriced (dimension unknown before basis build)"}
     mode = str(spec.get("mode") or "ell")
+    rate_source = (calibration or {}).get("source")
+    if tuning and tuning.get("rates"):
+        post = tuning["rates"].get(mode) \
+            or next(iter(tuning["rates"].values()), None)
+        if post and all(post.get(k) for k in TUNE_RATE_FIELDS):
+            calibration = post
+            rate_source = post.get("source", "posterior")
     num_terms = int(spec.get("num_terms") or 1)
     k = max(int(spec.get("k") or 1), 1)
     report = plan(int(n_states), num_terms,
@@ -528,7 +618,7 @@ def price_job(spec, calibration: Optional[dict] = None,
         f"{report['inputs']['n_devices']}")
     return {"est_apply_ms": est_apply_ms, "est_solve_s": est_solve_s,
             "fits": fits, "est_iters": est_iters, "priced": True,
-            "reason": reason,
+            "reason": reason, "rate_source": rate_source,
             "bytes_per_row": entry["bytes_per_row"],
             "max_rows_per_device": entry["max_rows_per_device"]}
 
@@ -593,6 +683,32 @@ def recommend(report: dict, target_n: Optional[int]) -> dict:
                           if pipelined_won else "") + hybrid_note)
         if pipelined_won:
             rec["recommended_pipeline"] = "auto"
+        # a tuned row BEATS the catalog rows (DESIGN.md §30): the
+        # autotuner priced the full knob cross-product for a real
+        # engine's geometry — when its config's mode fits this mesh and
+        # its price is no worse than the catalog pick, recommend running
+        # it (tune=static restores the exact artifact, search skipped)
+        tuned = (report.get("tuning") or {}).get("rows") or []
+        best_row = None
+        for row in tuned:
+            need = rec.get(f"devices_needed_{row['mode']}")
+            est = row.get("est_apply_ms")
+            if need is None or need > D or est is None:
+                continue
+            if best_row is None or est < best_row["est_apply_ms"]:
+                best_row = row
+        if best_row is not None and (
+                rec.get("est_apply_ms") is None
+                or best_row["est_apply_ms"] <= rec["est_apply_ms"]):
+            rec["recommended_mode"] = best_row["mode"]
+            rec["recommended_devices"] = rec[
+                f"devices_needed_{best_row['mode']}"]
+            rec["est_apply_ms"] = best_row["est_apply_ms"]
+            rec["tuned_config"] = best_row["token"]
+            rec["note"] = (
+                f"tuned {best_row['mode']} config {best_row['token']} "
+                f"prices {best_row['est_apply_ms']:,.2f} ms/apply — run "
+                "with tune=static (DMT_TUNE=static); " + rec["note"])
     else:
         # minimal-shard fallback: ties break AWAY from hybrid (fused
         # matches its device bytes without the host-plan dependency)
@@ -656,6 +772,15 @@ def print_report(report: dict, rec: dict) -> None:
                   f"{m['hybrid_stream_terms']}/{ins['num_terms']} terms "
                   f"streamed ({m['hybrid_stream_term_fraction']:.0%}), "
                   "rest recomputed on device")
+    tun = report.get("tuning")
+    if tun and tun.get("rows"):
+        print("  tuned configs (tune/ artifacts, --tuning):")
+        for row in tun["rows"]:
+            est = (f"est {row['est_apply_ms']:,.2f} ms/apply"
+                   if row.get("est_apply_ms") is not None else "unpriced")
+            print(f"    {row['mode']:<9} {row['token']}  {est}  "
+                  f"[{row['rate_source'] or 'saved'} rates, "
+                  f"fp {row['fingerprint']}]")
     print(f"  recommendation: {rec['note']}")
 
 
@@ -732,6 +857,14 @@ def main(argv=None) -> int:
                          "(default: the content-addressed sidecar under "
                          "the artifact root, when present) — adds "
                          "gather/stream-bound est_apply_ms per mode")
+    ap.add_argument("--tuning", nargs="?", const="auto", default=None,
+                    metavar="auto|off",
+                    help="fold the tune/ subsystem in (DESIGN.md §30): "
+                         "price at the live posterior's LEARNED rates "
+                         "when one has been persisted, and surface the "
+                         "saved tuned configs as rows the recommendation "
+                         "prefers over the catalog when they price "
+                         "better (run with tune=static to adopt one)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -779,6 +912,20 @@ def main(argv=None) -> int:
         n_devices = args.n_devices
 
     rates = load_rate_calibration(args.calibration)
+    tuning = None
+    if args.tuning and args.tuning != "off":
+        tuning = load_tuning()
+        if tuning and tuning.get("rates"):
+            # the streamed posterior is the broadest phase mix; any
+            # posterior beats the static catalog for pricing
+            post = tuning["rates"].get("streamed") \
+                or next(iter(tuning["rates"].values()), None)
+            if post:
+                rates = post
+        if tuning is None:
+            print("  --tuning: no posterior or tuned-config artifacts "
+                  "found (run an engine with DMT_TUNE=static|live first)",
+                  file=sys.stderr)
     report = plan(n_states, num_terms, T0, pair, args.hbm_gb, n_devices,
                   args.vectors, args.vec_width, measured=measured,
                   utilization=args.utilization,
@@ -786,6 +933,8 @@ def main(argv=None) -> int:
                   rates=rates,
                   stream_compress=args.stream_compress,
                   group_order=args.group_order)
+    if tuning:
+        report["tuning"] = tuning_report(tuning, rates)
     rec = recommend(report, int(args.target_n) if args.target_n else None)
     if args.json:
         print(json.dumps({"report": report, "recommendation": rec},

@@ -1627,7 +1627,10 @@ def main(argv=None) -> int:
                    help="print the machine-readable table dict")
 
     p = sub.add_parser("roofline", help="analytical roofline over the "
-                                        "run's apply_phases events")
+                                        "run's apply_phases events, plus "
+                                        "the autotuner's tune_config / "
+                                        "retune rows (priced vs tuned vs "
+                                        "measured)")
     p.add_argument("run", help="run dir or .jsonl with apply_phases events")
     p.add_argument("--calibration", default=None, metavar="PATH",
                    help="rate-calibration JSON (tools/gather_bound.py); "
