@@ -15,7 +15,7 @@ HEALTH_THRESHOLD ?= 0.02
 	obs-check health-check mem-check stream-check fault-check \
 	roofline-check compress-check trace-check pipeline-check \
 	hybrid-check serve-check elastic-check dynamics-check tune-check \
-	clean
+	slo-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -33,6 +33,7 @@ check:
 	$(MAKE) fault-check
 	$(MAKE) elastic-check
 	$(MAKE) tune-check
+	$(MAKE) slo-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -170,6 +171,21 @@ trace-check:
 # throughput/latency regression.  Deterministic seeds, ~90 s on CPU.
 serve-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/serve_check.py
+
+# Telemetry-plane gate (tools/slo_check.py, DESIGN.md §31): a clean
+# chain-12 solve where the registry snapshot, a REAL ephemeral-port
+# /metrics scrape, the textfile, and the events.jsonl metrics_snapshot
+# agree EXACTLY (OpenMetrics parity) with zero SLO alerts; DMT_OBS=off
+# binding no socket and writing nothing (provable no-op); a 6-job
+# spool drained clean vs under DMT_FAULT=solver_block:delay — the SAME
+# pinned serve_p99_latency_ms target passes then fails `obs_report slo`
+# (exit 1) with slo_alert events in the burned stream; and a forced
+# heartbeat stall (exit 76) leaving exactly one valid content-addressed
+# post-mortem bundle naming the stuck chunk span (`obs_report
+# postmortem` verifies).  Deterministic (the injected delay dwarfs
+# scheduler noise), ~60 s on the CPU rig.
+slo-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/slo_check.py
 
 # Dynamics gate (tools/dynamics_check.py, DESIGN.md §29): KPM moments
 # on a streamed chain_12 engine match the dense matrix's own Chebyshev

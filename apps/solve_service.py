@@ -71,6 +71,11 @@ def main(argv=None):
                     help="telemetry run directory (job_event/admission/"
                          "engine_pool events; `obs_report watch DIR` "
                          "renders the live queue panel)")
+    ap.add_argument("--port", type=int, default=None, metavar="PORT",
+                    help="serve GET /metrics (OpenMetrics) and "
+                         "GET /healthz on this port (default: "
+                         "DMT_OBS_PORT + rank when set, else no "
+                         "endpoint; 0 binds an ephemeral port)")
     args = ap.parse_args(argv)
 
     from distributed_matvec_tpu import obs
@@ -80,6 +85,12 @@ def main(argv=None):
 
     if args.obs_dir:
         update_config(obs_dir=args.obs_dir)
+
+    # crash observability BEFORE any heavy work: fatal-signal tracebacks
+    # land in the postmortem dir, and the scrape endpoint is live while
+    # the pool warms (liveness probes must not wait for the first batch)
+    obs.install_fatal_handlers()
+    server = obs.start_exporter(port=args.port)
 
     with obs.span("solve_service", kind="run"):
         pool = EnginePool(max_bytes=int(args.pool_gb * 1e9)
@@ -93,7 +104,10 @@ def main(argv=None):
                           poll_s=args.poll_s).run(
             drain=args.drain, max_idle_s=args.max_idle_s)
     obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    obs.write_textfile()       # the textfile rank 0's /metrics aggregates
     obs.flush()
+    if server is not None:
+        obs.stop_exporter()
     return rc
 
 

@@ -1111,6 +1111,15 @@ def _main():
             "unit": "ms",
             "vs_baseline": main_cfg.get("speedup_vs_numpy", 0),
         }
+    # one SLO pass over the finished run's ring BEFORE the artifacts are
+    # written: a burning objective (injected faults, drifting
+    # compression, straggling applies) lands a slo_alert in the stream,
+    # bumps slo_alert_count, and the lifetime count rides the bench
+    # record — bench_trend gates it zero-tolerantly (any alert on a
+    # previously clean config is a regression)
+    obs.check_slos()
+    main_cfg["slo_alert_count"] = int(
+        obs.snapshot().get("counters", {}).get("slo_alert_count", 0))
     detail_path = args.detail_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     try:
@@ -1161,6 +1170,9 @@ def _main():
     # as the run's closing event, then flush so `obs_report summarize`
     # reads a complete stream the moment this process exits
     obs.emit("metrics_snapshot", metrics=obs.snapshot())
+    # the scrape-less export path: the same snapshot as OpenMetrics text
+    # next to the rank's events.jsonl (node-exporter textfile collector)
+    obs.write_textfile()
     obs.flush()
     print(json.dumps(line))
     return 0

@@ -50,6 +50,12 @@ device-side work** (no syncs, no fetches — guard-tested).
 
 from .events import (annotate, emit, event_path, events, flush, obs_enabled,
                      reset, run_dir)
+from .export import (merge_openmetrics, parse_openmetrics,
+                     render_openmetrics, start_exporter, stop_exporter,
+                     textfile_path, write_textfile)
+from .flight import (flight_dump, install_fatal_handlers, list_bundles,
+                     postmortem_dir, read_bundle, reset_flight,
+                     verify_bundle)
 from .health import (HealthError, drain as drain_health, health_event_count,
                      health_mode, probes_enabled, record as record_health,
                      reset_health)
@@ -62,6 +68,8 @@ from .metrics import (DEFAULT_BUCKETS, NULL, counter, gauge, histogram,
                       reset_metrics, series_name)
 from .metrics import snapshot as _metrics_snapshot
 from .phases import (PHASES, emit_apply_phases, phases_enabled, zero_counts)
+from .slo import (SloSpec, check_slos, default_slos, reset_slo)
+from .slo import evaluate as evaluate_slos
 from .trace import (current_span_id, deepest_span, job_id, open_spans,
                     reset_trace, span, span_path, trace_enabled, trace_id)
 
@@ -118,6 +126,25 @@ __all__ = [
     "span_path",
     "trace_enabled",
     "trace_id",
+    "merge_openmetrics",
+    "parse_openmetrics",
+    "render_openmetrics",
+    "start_exporter",
+    "stop_exporter",
+    "textfile_path",
+    "write_textfile",
+    "flight_dump",
+    "install_fatal_handlers",
+    "list_bundles",
+    "postmortem_dir",
+    "read_bundle",
+    "reset_flight",
+    "verify_bundle",
+    "SloSpec",
+    "check_slos",
+    "default_slos",
+    "evaluate_slos",
+    "reset_slo",
 ]
 
 
@@ -130,10 +157,13 @@ def snapshot() -> dict:
 
 
 def reset_all() -> None:
-    """Reset events, metrics, health, memory AND trace state (test
-    isolation helper)."""
+    """Reset events, metrics, health, memory, trace, SLO and flight state
+    (test isolation helper); also stops a running exporter."""
+    stop_exporter()
     reset()
     reset_metrics()
     reset_health()
     reset_memory()
     reset_trace()
+    reset_slo()
+    reset_flight()

@@ -40,7 +40,8 @@ gates as the regression under the existing rule.
 
 from __future__ import annotations
 
-__all__ = ["HIGHER_IS_BETTER_TAGS", "is_higher_better"]
+__all__ = ["HIGHER_IS_BETTER_TAGS", "is_higher_better",
+           "METRIC_HELP", "metric_meta"]
 
 #: Substring tags marking rate-like metrics (higher is better).
 #: ``solves_per_min`` covers the solve service's throughput
@@ -61,3 +62,56 @@ HIGHER_IS_BETTER_TAGS = (
 def is_higher_better(metric: str) -> bool:
     """True when a LOWER value of ``metric`` is the regression."""
     return any(tag in metric for tag in HIGHER_IS_BETTER_TAGS)
+
+
+#: Help strings for the exporter's ``# HELP`` lines, keyed by the BASE
+#: instrument name (no labels).  This table rides next to the direction
+#: tags deliberately: the OpenMetrics exporter (``obs/export.py``) and the
+#: trend gates (``bench_trend``, ``obs_report diff``) read the SAME file,
+#: so a metric's type, direction and meaning are registered exactly once
+#: and the scrape plane can never drift from the gate plane.  A metric
+#: absent here still exports (help falls back to the name) — the table is
+#: documentation, not an allowlist.
+METRIC_HELP = {
+    "matvec_apply_ms": "Wall time of one eager matvec apply (ms)",
+    "double_buffer_stall_ms": "Producer wait on a busy device buffer (ms)",
+    "plan_stream_stall_ms": "Apply wait on plan-chunk staging (ms)",
+    "bytes_h2d": "Host-to-device bytes copied",
+    "bytes_d2h": "Device-to-host bytes copied",
+    "exchange_bytes": "Cross-shard exchange payload bytes",
+    "artifact_cache": "Artifact-cache events by kind/event label",
+    "aot_executable_cache": "AOT executable cache hits/misses",
+    "retrace_count": "Program retraces (shape/layout cache misses)",
+    "engine_table_bytes": "Resident engine structure-table bytes",
+    "ell_table_bytes": "Resident ELL structure-table bytes",
+    "stream_plan_bytes": "Resolved streamed-plan bytes (RAM or disk tier)",
+    "hbm_bytes_in_use": "Device memory in use at the last watermark poll",
+    "hbm_peak_bytes": "Peak device memory over the process lifetime",
+    "executable_temp_bytes": "Compiler-reported executable temp allocation",
+    "oom_events": "OomError diagnoses attached to resource exhaustion",
+    "compress_rel_err": "Measured streamed-plan decode relative error",
+    "matvec_output_norm": "Norm of the last probed apply output",
+    "matvec_nonfinite": "NaN/Inf elements counted by the health probes",
+    "exchange_overflow": "Exchange-capacity overflow events",
+    "exchange_invalid": "Invalid exchange-slot events",
+    "health_events": "Numerical-health events by level",
+    "fault_injected": "Injected faults fired (DMT_FAULT sites)",
+    "io_retry": "Idempotent I/O reads retried",
+    "engine_pool_bytes": "Serve-plane engine pool resident bytes",
+    "engine_pool_max_bytes": "Serve-plane engine pool byte budget",
+    "engine_pool_engines": "Warm engines resident in the serve pool",
+    "job_queue_depth": "Solve-service jobs queued or running",
+    "serve_batch_width": "Jobs packed into the in-flight solver batch",
+    "slo_alert_count": "SLO burn-rate alerts fired (lifetime)",
+    "flight_dump_count": "Flight-recorder post-mortem bundles written",
+}
+
+
+def metric_meta(name: str) -> dict:
+    """Everything the telemetry plane knows about base metric ``name``:
+    ``{"help": str, "higher_is_better": bool}``.  The instrument TYPE
+    (counter/gauge/histogram) is a property of the live registry, not of
+    the name — the exporter takes it from the snapshot section the series
+    appears in."""
+    return {"help": METRIC_HELP.get(name, name.replace("_", " ")),
+            "higher_is_better": is_higher_better(name)}

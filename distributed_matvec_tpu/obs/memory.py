@@ -546,6 +546,15 @@ def attach_oom(exc: BaseException, **context) -> Optional[OomError]:
     rd = report.to_dict()
     counter("oom_events").inc()
     emit("memory_report", level="critical", error=f"{exc}"[:500], **rd)
+    try:
+        # the flight recorder's OOM trigger: the bundle carries this
+        # report plus the open-span stack and the last ring events —
+        # lazily imported (flight imports this module the same way)
+        from .flight import flight_dump
+
+        flight_dump("oom", error=f"{exc}"[:500], report=rd)
+    except Exception:
+        pass
     detail = " ".join(f"{k}={v}" for k, v in context.items())
     lines = "\n  - ".join(report.remediation)
     msg = (f"device memory exhausted ({detail}): {exc}\n"

@@ -212,6 +212,16 @@ class HeartbeatWatchdog:
                     flush()
                 except Exception:
                     pass
+                try:
+                    # post-mortem bundle BEFORE on_stall: the default
+                    # handler is os._exit(76), so the bundle (carrying
+                    # this rank's span_path) must already be on disk
+                    from ..obs.flight import flight_dump
+
+                    flight_dump("stall", exit_code=EXIT_STALLED,
+                                report=report)
+                except Exception:
+                    pass
                 self.on_stall(report)
                 return
             self._stop.wait(self.interval_s)

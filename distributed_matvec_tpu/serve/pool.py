@@ -22,6 +22,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from ..obs import emit as obs_emit
+from ..obs import gauge as obs_gauge
 from ..utils.config import get_config
 from .spec import JobSpec
 
@@ -210,6 +211,12 @@ class EnginePool:
             self._event("evict", victim, freed_bytes=int(freed))
 
     def _event(self, event: str, key: str, **extra) -> None:
+        # gauges mirror the event payload so the OpenMetrics exporter and
+        # the watch panel read the SAME values (ISSUE 17: pool occupancy
+        # existed only as events before)
+        obs_gauge("engine_pool_bytes").set(self.total_bytes())
+        obs_gauge("engine_pool_max_bytes").set(self.max_bytes)
+        obs_gauge("engine_pool_engines").set(len(self._engines))
         obs_emit("engine_pool", event=event, engine_key=key,
                  engine_bytes=int(self._bytes.get(key, 0)),
                  pool_bytes=int(self.total_bytes()),

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..obs import emit as obs_emit
+from ..obs import gauge as obs_gauge
 from ..obs.trace import job_scope
 from .spec import JobSpec
 
@@ -235,6 +236,9 @@ class JobQueue:
     # -- events ------------------------------------------------------------
 
     def _event(self, spec: JobSpec, status: str, **extra) -> None:
+        # depth gauge rides every transition: the exporter's
+        # job_queue_depth and the watch panel's queue line must agree
+        obs_gauge("job_queue_depth").set(self.pending())
         # job_scope: the envelope job_id IS the job (payload job_id
         # fields are dropped by the envelope-wins rule) — the watch
         # queue panel and `obs_report trace` key per-job state on it

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..obs import emit as obs_emit
+from ..obs import gauge as obs_gauge
 from ..obs import trace as obs_trace
 from ..utils import preempt
 from ..utils.config import get_config
@@ -235,6 +236,10 @@ class Scheduler:
 
         key = batch[0].engine_key()
         t_start = time.time()
+        # in-flight width as a real gauge (reset on every exit path
+        # below): the exporter's serve_batch_width and the job_event
+        # payloads must tell the same story
+        obs_gauge("serve_batch_width").set(len(batch))
         for spec in batch:
             self.queue.mark_running(spec, batch_width=len(batch))
         try:
@@ -291,6 +296,8 @@ class Scheduler:
                 self._finish(spec, FAILED, t_start, error=repr(e))
             obs_emit("serve_batch_failed", engine_key=key, error=repr(e))
             return [self.queue.result(s.job_id) for s in batch]
+        finally:
+            obs_gauge("serve_batch_width").set(0)
 
     def _run_dynamics(self, spec: JobSpec, eng, solver: str,
                       t_start: float) -> dict:

@@ -17,6 +17,7 @@ import time
 from typing import Optional
 
 from ..obs import emit as obs_emit, flush as obs_flush
+from ..obs.slo import check_slos
 from ..utils import preempt
 from ..utils.preempt import EXIT_PREEMPTED, Preempted
 from .queue import JobQueue
@@ -54,6 +55,12 @@ class SolveService:
             while True:
                 n = sched.drain(scan_spool=True)
                 finished += n
+                if n:
+                    # SLO pass at the batch boundary: evaluates the live
+                    # event ring, emits slo_alert ONLY on firing/clear
+                    # transitions — a healthy service's stream stays
+                    # alert-free (obs/slo.py)
+                    check_slos()
                 if drain and sched.queue.pending() == 0:
                     break
                 if n:

@@ -149,6 +149,16 @@ def quarantine_artifact(path: str, kind: str, reason: str = "") -> bool:
              moved_to=dest, reason=reason)
     except Exception:
         pass
+    try:
+        # a quarantine means a cache is actively serving corrupt bytes —
+        # bundle the context (what was being read, by which span) so the
+        # post-mortem names the artifact even if the run later dies
+        from ..obs.flight import flight_dump
+
+        flight_dump("quarantine", artifact_kind=kind, path=path,
+                    moved_to=dest, error=reason)
+    except Exception:
+        pass
     log_warn(f"quarantined corrupt {kind} artifact: {path} -> {dest}")
     return True
 

@@ -56,6 +56,18 @@ class RuntimeConfig:
     #   (DMT_JOB_ID): stamped into every event envelope; empty defaults to
     #   the run's trace id.  The groundwork the solve service needs to
     #   multiplex many concurrent jobs' telemetry through shared engines
+    obs_port: int = 0                      # OpenMetrics exporter base port
+    #   (DMT_OBS_PORT, obs/export.py): >0 → each rank serves GET /metrics
+    #   (Prometheus text format, fresh registry snapshot per scrape) and
+    #   GET /healthz on port obs_port + rank; rank 0's /metrics also
+    #   aggregates every peer's textfile under the shared run directory.
+    #   0 (the default) binds nothing, and DMT_OBS=off never touches a
+    #   socket regardless — the provable-no-op contract
+    flight_ring: int = 256                 # flight-recorder ring depth
+    #   (DMT_FLIGHT_RING, obs/flight.py): how many of the newest in-memory
+    #   events a post-mortem bundle carries alongside the open-span stack,
+    #   metrics snapshot and config identity when a rank dies (OOM, stall
+    #   exit 76, preemption exit 75, quarantine, fatal signals)
     phases: str = "on"                     # per-apply phase attribution
     #   (DMT_PHASES): "on" emits one `apply_phases` event per eager apply
     #   (host-side structural counts only — the apply HLO is byte-identical

@@ -38,7 +38,7 @@ import threading
 from typing import Optional
 
 __all__ = ["EXIT_PREEMPTED", "Preempted", "ensure_installed", "requested",
-           "agreed", "trigger", "reset"]
+           "agreed", "trigger", "reset", "set_flight_hook"]
 
 #: Distinct exit code for a checkpoint-and-exit preemption (EX_TEMPFAIL:
 #: transient failure, relaunch with the same argv to resume).
@@ -47,6 +47,8 @@ EXIT_PREEMPTED = 75
 _latch = False
 _signum: Optional[int] = None
 _prev: dict = {}
+_flight_hook = None           # obs/flight registers its dump at import
+_flight_fired = False
 
 
 class Preempted(Exception):
@@ -109,8 +111,33 @@ def ensure_installed(signals=(signal.SIGTERM,)) -> bool:
     return ok
 
 
+def set_flight_hook(fn) -> None:
+    """Register the flight recorder's dump callback (``obs/flight.py``
+    does this at import).  The signal handler itself stays I/O-free per
+    its contract, so the hook runs on the SOLVE thread the first time the
+    latch is observed via :func:`requested` — a safe context where file
+    writes and locks are allowed.  ``fn(signum)`` is called at most once
+    per process; a failing hook is dropped (a crash-path diagnostic must
+    never break the graceful exit it documents)."""
+    global _flight_hook
+    _flight_hook = fn
+
+
+def _fire_flight_hook() -> None:
+    global _flight_hook, _flight_fired
+    if _flight_fired or _flight_hook is None:
+        return
+    _flight_fired = True
+    try:
+        _flight_hook(_signum)
+    except Exception:
+        _flight_hook = None
+
+
 def requested() -> bool:
     """Whether a preemption signal has been latched (this process)."""
+    if _latch:
+        _fire_flight_hook()
     return _latch
 
 
@@ -149,6 +176,7 @@ def trigger() -> None:
 def reset() -> None:
     """Clear the latch (tests; a resumed in-process solve after a handled
     ``Preempted``)."""
-    global _latch, _signum
+    global _latch, _signum, _flight_fired
     _latch = False
     _signum = None
+    _flight_fired = False

@@ -85,6 +85,81 @@ if os.environ.get("DMT_MH_TRACE"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_EXPORT"):
+    # OpenMetrics-export leg (tests/test_slo.py): each rank of a REAL
+    # 2-process job serves its own /metrics + /healthz on
+    # DMT_OBS_PORT + rank (the side-by-side endpoint contract of
+    # obs/export.py) while rank 0's /metrics aggregates rank 1's
+    # textfile into one document.  Each rank scrapes BOTH endpoints and
+    # asserts one consistent trace_id — the file-agreed id the shared
+    # run directory distributes.  A small rank-local solve first (same
+    # CPU-backend constraint as every fast leg here) so the scraped
+    # registries carry real solver series; correctness still asserted
+    # so a broken solve cannot masquerade as an export pass.
+    import time as _time
+    import urllib.request
+
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+    from distributed_matvec_tpu.solve import lanczos_block
+
+    def _scrape(url, timeout_s=60.0):
+        deadline = _time.monotonic() + timeout_s
+        while True:                       # peers bind at their own pace
+            try:
+                return urllib.request.urlopen(url, timeout=5).read().decode()
+            except Exception:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.2)
+
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="ell")
+    res = lanczos_block(eng.matvec, k=1, tol=1e-8, max_iters=24, seed=3)
+    e0 = float(res.eigenvalues[0])
+    assert abs(e0 / 4 - E0_OVER_4) < 5e-3, e0   # truncated solve: coarse
+
+    base = int(os.environ["DMT_OBS_PORT"])
+    server = obs.start_exporter()         # resolves DMT_OBS_PORT + rank
+    assert server is not None and server.port == base + pid, \
+        (server and server.port, base, pid)
+    obs.write_textfile()                  # what rank 0's scrape aggregates
+
+    import json as _json
+    tids = set()
+    for r in range(nproc):
+        health = _json.loads(_scrape(f"http://127.0.0.1:{base + r}/healthz"))
+        assert health["status"] == "ok" and health["rank"] == r, health
+        tids.add(health.get("trace_id"))
+    assert tids == {obs.trace_id()}, (tids, obs.trace_id())
+
+    if pid == 0:
+        # rank 0's own endpoint merges the peer textfile: one scrape,
+        # every rank's samples, disjoint by the rank label
+        peer_tf = obs.textfile_path(rank=1)
+        deadline = _time.monotonic() + 60.0
+        while not os.path.exists(peer_tf):
+            assert _time.monotonic() < deadline, f"no peer textfile {peer_tf}"
+            _time.sleep(0.2)
+        agg = _scrape(f"http://127.0.0.1:{base}/metrics")
+        assert 'rank="0"' in agg and 'rank="1"' in agg, agg[:400]
+    print(f"[p{pid}] EXPORT_TRACE_ID {obs.trace_id()}", flush=True)
+    # file barrier before shutdown: a rank must keep serving until the
+    # PEER has finished scraping it, or the cross-scrape above races the
+    # teardown
+    mine = os.path.join(obs.run_dir(), f"rank_{pid}", "export_done")
+    with open(mine, "w") as f:
+        f.write("done\n")
+    peer = os.path.join(obs.run_dir(), f"rank_{1 - pid}", "export_done")
+    deadline = _time.monotonic() + 60.0
+    while not os.path.exists(peer):
+        assert _time.monotonic() < deadline, f"peer never finished: {peer}"
+        _time.sleep(0.1)
+    obs.stop_exporter()
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 if os.environ.get("DMT_MH_PIPE") is not None:
     # Pipelined-apply leg for the barrier gate (tools/pipeline_check.py
     # and tests/test_engine_pipelined.py): a streamed engine per rank

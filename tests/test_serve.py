@@ -577,6 +577,10 @@ def test_scheduler_adopts_spool_and_rejects_unfit(tmp_path):
 # the REAL 2-process leg
 
 
+@pytest.mark.skipif(os.cpu_count() == 1,
+                    reason="two 4-device ranks wedge XLA's intra-process "
+                           "collective rendezvous on a 1-CPU host (3/4 "
+                           "participants arrive, the solve never returns)")
 def test_multihost_serve_two_ranks(tmp_path):
     """2-process run (multihost worker harness, serve leg): two
     same-basis jobs drained through a rank-local-mesh engine pool share

@@ -1,0 +1,387 @@
+"""Production telemetry plane (ISSUE 17): OpenMetrics export parity,
+SLO burn-rate evaluation + alert transitions, the crash flight recorder,
+and the ``obs_report slo`` / ``postmortem`` readers.
+
+The suite-wide conftest strips ``DMT_OBS_DIR``/``DMT_OBS`` from the
+environment, so the layer runs enabled + in-memory by default; tests
+that need a sink or the off state set it themselves and reset around.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from distributed_matvec_tpu import obs
+from distributed_matvec_tpu.obs.slo import SloSpec, default_slos, evaluate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def clean_obs():
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+@pytest.fixture
+def obs_off(monkeypatch):
+    monkeypatch.setenv("DMT_OBS", "off")
+
+
+def _fill_registry():
+    obs.counter("slo_test_total").inc(3)
+    obs.counter("slo_test_labeled", engine="local").inc()
+    obs.gauge("slo_test_gauge").set(0.1 + 0.2)      # not repr-trivial
+    h = obs.histogram("slo_test_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    return obs.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics export parity
+
+
+def test_openmetrics_render_parse_roundtrip(clean_obs):
+    """parse(render(snapshot)) == snapshot EXACTLY — repr floats survive
+    the text round trip, histograms keep buckets/sum/count."""
+    snap = _fill_registry()
+    text = obs.render_openmetrics(snap)
+    assert "# EOF" in text
+    assert obs.parse_openmetrics(text) == snap
+
+
+def test_openmetrics_merge_disjoint_ranks(clean_obs):
+    snap = _fill_registry()
+    r0 = obs.render_openmetrics(snap, extra_labels={"rank": "0"})
+    r1 = obs.render_openmetrics(snap, extra_labels={"rank": "1"})
+    merged = obs.merge_openmetrics([r0, r1])
+    assert merged.count("# EOF") == 1
+    assert 'rank="0"' in merged and 'rank="1"' in merged
+
+
+def test_http_scrape_equals_registry(clean_obs):
+    """A REAL ephemeral-port scrape agrees exactly with the registry."""
+    snap = _fill_registry()
+    server = obs.start_exporter(port=0)
+    try:
+        assert server is not None and server.port > 0
+        url = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{url}/metrics",
+                                      timeout=10).read().decode()
+        assert obs.parse_openmetrics(body) == snap
+        health = json.loads(urllib.request.urlopen(
+            f"{url}/healthz", timeout=10).read().decode())
+        assert health["status"] == "ok"
+        assert health["rank"] == 0
+    finally:
+        obs.stop_exporter()
+
+
+def test_textfile_roundtrip(clean_obs, monkeypatch, tmp_path):
+    monkeypatch.setenv("DMT_OBS_DIR", str(tmp_path / "run"))
+    snap = _fill_registry()
+    path = obs.write_textfile()
+    assert path and path.endswith("metrics.prom")
+    with open(path) as f:
+        assert obs.parse_openmetrics(f.read()) == snap
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation (pure: synthetic event lists)
+
+
+def _apply_events(values, t0=1000.0, dt=1.0):
+    return [{"kind": "matvec_apply", "ts": t0 + i * dt, "wall_ms": v}
+            for i, v in enumerate(values)]
+
+
+def test_slo_threshold_pinned_target_fires():
+    spec = SloSpec("steady_apply_ms", kind="matvec_apply", field="wall_ms",
+                   target=10.0)
+    # 3/10 samples violate: frac 0.3 / budget 0.01 = burn 30 > both
+    # window thresholds (14.4x / 6x) => firing
+    st, = evaluate(_apply_events([1.0] * 7 + [100.0] * 3), [spec])
+    assert st["state"] == "firing"
+    assert all(w["burn"] > w["max_burn"] for w in st["windows"])
+    # 1/100 violating stays inside the objective budget
+    st, = evaluate(_apply_events([1.0] * 99 + [100.0]), [spec])
+    assert st["state"] == "ok"
+
+
+def test_slo_auto_baseline_from_head():
+    """target=None self-baselines: median of the earliest quartile x
+    slack — a 50x late-run regression fires without any pinned number."""
+    spec = SloSpec("steady_apply_ms", kind="matvec_apply", field="wall_ms")
+    st, = evaluate(_apply_events([10.0] * 20 + [500.0] * 10), [spec])
+    assert st["state"] == "firing"
+    assert st["target"] == pytest.approx(40.0)      # median 10 * slack 4
+    st, = evaluate(_apply_events([10.0] * 30), [spec])
+    assert st["state"] == "ok"
+
+
+def test_slo_multiwindow_requires_every_window():
+    """A burst that only pollutes the short window must NOT page: the
+    long window's burn stays under its threshold."""
+    spec = SloSpec("steady_apply_ms", kind="matvec_apply", field="wall_ms",
+                   target=10.0, windows=((60.0, 10.0), (3600.0, 30.0)))
+    # 3000 old-good + 10 recent-bad: short window 100% bad (burn 100),
+    # long window frac 10/3010 => burn ~0.33 < 30
+    events = _apply_events([1.0] * 3000, t0=0.0, dt=1.0) + \
+        _apply_events([100.0] * 10, t0=3005.0, dt=1.0)
+    st, = evaluate(events, [spec])
+    assert st["state"] == "ok"
+    assert st["windows"][0]["burn"] > st["windows"][0]["max_burn"]
+    assert st["windows"][1]["burn"] < st["windows"][1]["max_burn"]
+
+
+def test_slo_no_data_and_count_modes():
+    statuses = {s["name"]: s for s in evaluate([], default_slos())}
+    assert statuses["steady_apply_ms"]["state"] == "no-data"
+    assert statuses["faults_injected"]["state"] == "ok"   # zero events
+    st = {s["name"]: s for s in evaluate(
+        [{"kind": "fault_injected", "ts": 1.0, "site": "x"}],
+        default_slos())}["faults_injected"]
+    assert st["state"] == "firing"          # allowed/h = 0: any is too many
+    assert st["worst_burn"] == float("inf")
+
+
+def test_slo_rate_min_short_run_clamps_window():
+    """The rate denominator clamps to the observed span: a 2-s CI drain
+    at 6 solves must NOT grade as ~1/min against a 300-s window."""
+    done = [{"kind": "job_event", "status": "done", "ts": 1000.0 + 0.4 * i,
+             "latency_ms": 100.0} for i in range(6)]
+    spec = SloSpec("serve_solves_per_min", kind="job_event",
+                   where={"status": "done"}, mode="rate_min", target=60.0)
+    st, = evaluate(done, [spec])
+    assert st["state"] == "ok"              # ~180/min over the 2-s span
+    # a genuinely slow drain still fires the floor
+    slow = [{"kind": "job_event", "status": "done", "ts": 1000.0 + 30.0 * i,
+             "latency_ms": 100.0} for i in range(6)]
+    st, = evaluate(slow, [spec])
+    assert st["state"] == "firing"          # 2.4/min < 60/min floor
+
+
+def test_check_slos_alert_transitions(clean_obs):
+    """ok->firing emits ONE critical slo_alert + bumps slo_alert_count;
+    steady firing emits nothing; recovery emits state=clear."""
+    spec = SloSpec("steady_apply_ms", kind="matvec_apply", field="wall_ms",
+                   target=10.0)
+    bad = _apply_events([100.0] * 10)
+    obs.check_slos([spec], events=bad)
+    obs.check_slos([spec], events=bad)      # steady: no second alert
+    alerts = [e for e in obs.events() if e.get("kind") == "slo_alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["state"] == "firing"
+    assert alerts[0]["slo"] == "steady_apply_ms"
+    assert alerts[0]["level"] == "critical"
+    assert obs.snapshot()["counters"]["slo_alert_count"] == 1
+    obs.check_slos([spec], events=_apply_events([1.0] * 10))
+    alerts = [e for e in obs.events() if e.get("kind") == "slo_alert"]
+    assert [a["state"] for a in alerts] == ["firing", "clear"]
+    assert obs.snapshot()["counters"]["slo_alert_count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def _crash_site(run, monkeypatch):
+    monkeypatch.setenv("DMT_OBS_DIR", str(run))
+    obs.reset_all()
+    obs.emit("engine_init", mode="ell")
+    obs.counter("slo_test_total").inc()
+
+
+def test_flight_dump_bundle_roundtrip(clean_obs, monkeypatch, tmp_path):
+    _crash_site(tmp_path / "run", monkeypatch)
+    with obs.span("lanczos", kind="solve"):
+        with obs.span("apply", kind="apply", apply=7):
+            path = obs.flight_dump("stall", exit_code=76,
+                                   report={"stalled": [1]})
+    assert path and os.path.basename(path).startswith("stall-")
+    bundle = obs.read_bundle(path)
+    assert bundle["reason"] == "stall" and bundle["exit_code"] == 76
+    assert bundle["report"] == {"stalled": [1]}
+    assert bundle["span_path"] == "lanczos>apply"
+    assert bundle["span"]["kind"] == "apply"
+    assert any(e.get("kind") == "engine_init" for e in bundle["events"])
+    assert bundle["metrics"]["counters"]["slo_test_total"] == 1
+    assert obs.verify_bundle(path)
+    assert obs.list_bundles() == [path]
+    # content address: the name IS the hash of the bytes
+    digest = os.path.basename(path).split("-", 1)[1].split(".")[0]
+    assert len(digest) == 16
+    # once per reason; reset re-arms
+    assert obs.flight_dump("stall") is None
+    assert obs.flight_dump("oom", exit_code=1) is not None
+    obs.reset_flight()
+    assert obs.flight_dump("stall") is not None
+
+
+def test_flight_bundle_tamper_detected(clean_obs, monkeypatch, tmp_path):
+    _crash_site(tmp_path / "run", monkeypatch)
+    path = obs.flight_dump("stall", exit_code=76)
+    assert obs.verify_bundle(path)
+    bundle = json.load(open(path))
+    bundle["exit_code"] = 0                 # the cover-up
+    with open(path, "w") as f:
+        json.dump(bundle, f)
+    assert not obs.verify_bundle(path)
+
+
+def test_flight_dump_without_sink_is_none(clean_obs):
+    assert obs.run_dir() is None
+    assert obs.flight_dump("stall", exit_code=76) is None
+
+
+# ---------------------------------------------------------------------------
+# DMT_OBS=off: provable no-op
+
+
+def test_obs_off_everything_inert(obs_off, tmp_path, monkeypatch):
+    monkeypatch.setenv("DMT_OBS_DIR", str(tmp_path / "never"))
+    assert not obs.obs_enabled()
+    assert obs.start_exporter(port=0) is None
+    assert obs.write_textfile() is None
+    assert obs.flight_dump("stall", exit_code=76) is None
+    assert obs.postmortem_dir() is None
+    assert obs.check_slos() == []
+    obs.emit("probe", x=1)
+    assert obs.events() == []
+    assert not os.path.exists(str(tmp_path / "never"))
+
+
+# ---------------------------------------------------------------------------
+# obs_report slo / postmortem readers
+
+
+def _write_run(run, events):
+    rank = os.path.join(run, "rank_0")
+    os.makedirs(rank, exist_ok=True)
+    with open(os.path.join(rank, "events.jsonl"), "w") as f:
+        for i, e in enumerate(events):
+            f.write(json.dumps({"seq": i, "rank": 0, **e}) + "\n")
+
+
+def test_obs_report_slo_exit_codes(tmp_path):
+    rep = _load_tool("obs_report")
+    run = str(tmp_path / "run")
+    _write_run(run, _apply_events([10.0] * 20 + [500.0] * 10))
+    assert rep.main(["slo", run]) == 1              # auto-baseline burns
+    assert rep.main(["slo", run, "--target", "steady_apply_ms=1000"]) == 0
+    out = json.loads("".join(_capture_json(rep, ["slo", run, "--json"])))
+    by = {s["name"]: s for s in out}
+    assert by["steady_apply_ms"]["state"] == "firing"
+
+
+def _capture_json(rep, argv):
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rep.main(argv)
+    return buf.getvalue()
+
+
+def test_obs_report_postmortem(clean_obs, monkeypatch, tmp_path):
+    rep = _load_tool("obs_report")
+    run = str(tmp_path / "run")
+    _crash_site(tmp_path / "run", monkeypatch)
+    # no bundle yet: exit 2 (distinct from "bundle invalid")
+    assert rep.main(["postmortem", run]) == 2
+    with obs.span("lanczos", kind="solve"):
+        path = obs.flight_dump("stall", exit_code=76,
+                               report={"stalled": [1]})
+    assert rep.main(["postmortem", run]) == 0
+    entries = rep.scan_postmortems(run)
+    assert len(entries) == 1 and entries[0]["valid"]
+    assert entries[0]["bundle"]["span_path"] == "lanczos"
+    with open(path, "a") as f:                      # torn write
+        f.write("}")
+    assert rep.main(["postmortem", run]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the REAL 2-process export leg
+
+
+def _free_port_pair():
+    import socket
+    for _ in range(20):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        try:
+            with socket.socket() as t:
+                t.bind(("127.0.0.1", base + 1))
+        except OSError:
+            continue
+        return base
+    raise RuntimeError("no adjacent free port pair")
+
+
+def test_multihost_export_two_ranks(tmp_path):
+    """2-process run (multihost worker harness, export leg): each rank
+    serves /metrics + /healthz on DMT_OBS_PORT + rank, both ranks scrape
+    both endpoints and agree on ONE trace id, and rank 0's endpoint
+    aggregates rank 1's textfile into one labeled document."""
+    import socket
+    import subprocess
+
+    rep = _load_tool("obs_report")
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = s.getsockname()[1]
+    base = _free_port_pair()
+    run = tmp_path / "export_run"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["DMT_MH_EXPORT"] = "1"
+    env["DMT_OBS_DIR"] = str(run)
+    env["DMT_OBS_PORT"] = str(base)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(coord)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    tids = set()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out[-2000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-2000:]
+        line = [ln for ln in out.splitlines()
+                if ln.startswith(f"[p{pid}] EXPORT_TRACE_ID ")][0]
+        tids.add(line.split()[-1])
+    # one scraped trace id across both ranks, and it IS the run's id
+    assert len(tids) == 1
+    events = rep.load_events(str(run))
+    assert {e.get("trace_id") for e in events} == tids
+    # each rank left its textfile, parseable stand-alone
+    for r in (0, 1):
+        tf = run / f"rank_{r}" / "metrics.prom"
+        assert tf.exists()
+        parsed = obs.parse_openmetrics(tf.read_text())
+        assert parsed["counters"] or parsed["histograms"]

@@ -75,6 +75,7 @@ METRIC_WHITELIST = (
     "kpm_moments_per_s", "kpm_dos_rel_err", "kpm_n_moments",
     "kpm_apply_ms", "evolve_steps_per_s", "evolve_norm_drift",
     "evolve_energy_drift", "evolve_steps",
+    "slo_alert_count",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -127,7 +128,19 @@ DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 # a PR that quietly slows the KPM moment recurrence or
                 # the Krylov evolution step loop fails the gate even
                 # when raw apply walls hold
-                "kpm_moments_per_s", "evolve_steps_per_s")
+                "kpm_moments_per_s", "evolve_steps_per_s",
+                # SLO burn-rate alerts fired during the bench run
+                # (obs/slo.py via bench.py's closing check_slos pass):
+                # gated ZERO-TOLERANTLY below — the healthy baseline is
+                # exactly 0, which the relative gate would skip, so any
+                # alert on a previously alert-free config regresses
+                "slo_alert_count")
+
+#: Incident counters whose healthy baseline is exactly zero: gated
+#: absolutely (any increase beyond threshold x baseline regresses, so a
+#: zero baseline means ANY occurrence fails) instead of being skipped by
+#: the zero-baseline rule above.
+GATE_ZERO_TOLERANT = ("slo_alert_count",)
 
 #: Absolute noise floors per gated metric: a baseline below the floor is
 #: scheduler jitter, not a trajectory (``barrier_ms`` on a healthy
@@ -268,6 +281,14 @@ def gate(records: List[dict], threshold: float,
             if not cand:
                 continue
             b = max(cand) if hib else min(cand)
+            if metric in GATE_ZERO_TOLERANT:
+                # zero IS the meaningful baseline here (see the constant)
+                rel = ((float(nv) - b) / abs(b)) if b else (
+                    float("inf") if float(nv) > 0 else 0.0)
+                rows.append((cfg, metric, b, float(nv), rel))
+                if float(nv) > b + threshold * abs(b):
+                    regressions.append((cfg, metric, b, float(nv), rel))
+                continue
             if not b:
                 continue
             if abs(b) < GATE_MIN_BASELINE.get(metric, 0.0):

@@ -100,12 +100,32 @@ Subcommands::
         Human-readable view of the last events; ``--follow`` keeps reading
         as a live run appends (rotated/recreated files are reopened on
         inode change, so a restarted writer never silently drops the tail).
+
+    slo RUN [--target NAME=VALUE ...] [--json]
+        Evaluate the stock burn-rate SLO set (``obs/slo.py`` — serve p99
+        latency, solves/min floor, steady apply/iteration walls,
+        compression drift, stall/fault/OOM incident counters) over a
+        recorded run, post hoc and deterministic (windows anchor on the
+        newest event timestamp).  ``--target`` pins an explicit objective
+        by SLO name (repeatable); unpinned thresholds self-baseline from
+        the run's earliest quartile.  Exits 1 when any SLO is firing —
+        the CI shape ``make slo-check`` drives.
+
+    postmortem RUN [--json]
+        Read the crash flight-recorder bundles a dying rank left under
+        ``rank_<r>/postmortem/`` (``obs/flight.py``): per bundle the
+        trigger (stall/preempt/oom/quarantine), exit code, rank,
+        trace/job identity, the span the process died inside, and the
+        content-address verification (the filename's sha16 is re-hashed
+        against the bytes — a torn or tampered bundle is flagged loudly
+        and exits 1).  ``RUN`` may also be one bundle ``.json`` path.
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import hashlib
 import json
 import os
 import statistics
@@ -133,6 +153,26 @@ def _load_directions():
 
 
 _is_higher_better = _load_directions().is_higher_better
+
+
+def _load_slo():
+    """File-load ``obs/slo.py`` (same pattern as the directions table):
+    its import-dual header falls back to the pure standalone evaluation
+    surface, so the ``slo`` subcommand never imports the package (and
+    therefore never initializes a JAX backend just to grade a run)."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "distributed_matvec_tpu", "obs", "slo.py")
+    spec = importlib.util.spec_from_file_location("dmt_obs_slo", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves string annotations through
+    # sys.modules[cls.__module__] — an unregistered file-loaded module
+    # would crash @dataclass on 3.10
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
 
 _DEFAULT_GATE = ("device_ms",)
 
@@ -443,6 +483,28 @@ def run_summary(events: List[dict]) -> dict:
                                 "invalid", "omega") if k in ev}
         for ev in events if ev.get("kind") in ("health", "solver_health")]
 
+    # SLO alerting + flight-recorder digest: slo_alert transitions per
+    # SLO name, the lifetime alert/dump counters from the final
+    # snapshot, and every crash bundle the run left behind
+    slo_alerts: Dict[str, Dict[str, int]] = {}
+    for ev in events:
+        if ev.get("kind") != "slo_alert":
+            continue
+        rec = slo_alerts.setdefault(str(ev.get("slo")),
+                                    {"fired": 0, "cleared": 0})
+        rec["fired" if ev.get("state") == "firing" else "cleared"] += 1
+    slo_counters: Dict[str, int] = {}
+    if snaps:
+        for name, val in snaps[-1].get("metrics", {}) \
+                .get("counters", {}).items():
+            if name.split("{", 1)[0] in ("slo_alert_count",
+                                         "flight_dump_count"):
+                slo_counters[name] = int(val)
+    flight_dumps = [
+        {k: ev.get(k) for k in ("rank", "reason", "exit_code", "bundle",
+                                "span_path") if k in ev}
+        for ev in events if ev.get("kind") == "flight_dump"]
+
     ident = {}
     for ev in events:
         if ev.get("trace_id"):
@@ -457,6 +519,8 @@ def run_summary(events: List[dict]) -> dict:
             "cache": cache,
             "health": {"counters": health_counters,
                        "events": health_events},
+            "slo": {"alerts": slo_alerts, "counters": slo_counters,
+                    "flight_dumps": flight_dumps},
             "memory": memory_summary(events),
             "phases": phases_summary(events),
             "bench": bench_metrics(events),
@@ -516,6 +580,21 @@ def print_summary(s: dict) -> None:
                 print(f"    {ev.get('kind')}: {detail}")
         else:
             print("  no health events (clean run)")
+    slo = s.get("slo") or {}
+    if slo.get("alerts") or slo.get("counters") or slo.get("flight_dumps"):
+        # conditional by design: alert-free, crash-free runs summarize
+        # exactly as before this section existed
+        print("\nslo alerts / flight recorder:")
+        for name, rec in sorted((slo.get("alerts") or {}).items()):
+            print(f"  {name:<36} fired {rec['fired']}, "
+                  f"cleared {rec['cleared']}")
+        for name, val in sorted((slo.get("counters") or {}).items()):
+            print(f"  {name:<44} {val}")
+        for fd in slo.get("flight_dumps") or []:
+            where = f" in {fd['span_path']}" if fd.get("span_path") else ""
+            print(f"  flight_dump rank {fd.get('rank')}: "
+                  f"{fd.get('reason')} (exit {fd.get('exit_code')})"
+                  f"{where} -> {fd.get('bundle')}")
     mem = s.get("memory") or {}
     if any(mem.get(k) for k in ("top_allocations", "peak_hbm_bytes",
                                 "executables", "oom_events")):
@@ -1124,7 +1203,8 @@ def empty_watch_base() -> dict:
     rate/solver/phase state only ever needs the retained tail."""
     return {"n_events": 0, "applies": {}, "bytes": {},
             "health": {"warn": 0, "critical": 0, "faults": 0,
-                       "io_retries": 0, "stalls": 0}}
+                       "io_retries": 0, "stalls": 0},
+            "alerts": 0, "slo_firing": {}}
 
 
 def watch_fold(base: dict, dropped: List[dict]) -> dict:
@@ -1148,6 +1228,18 @@ def watch_fold(base: dict, dropped: List[dict]) -> dict:
             base["health"]["io_retries"] += 1
         elif kind == "stall_report":
             base["health"]["stalls"] += 1
+        elif kind == "slo_alert":
+            # an alert's firing/clear pair may be split by the trim —
+            # carry the latched firing state alongside the total so the
+            # panel stays truthful across a bounded multi-hour watch
+            name = str(ev.get("slo"))
+            if ev.get("state") == "firing":
+                base["alerts"] = base.get("alerts", 0) + 1
+                base.setdefault("slo_firing", {})[name] = {
+                    "burn": ev.get("burn"), "target": ev.get("target"),
+                    "mode": ev.get("mode")}
+            else:
+                base.setdefault("slo_firing", {}).pop(name, None)
     return base
 
 
@@ -1179,6 +1271,11 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
     serve_admissions: Dict[str, int] = {}
     serve_last_admission = None
     serve_pool = None
+    # SLO burn-rate alert state (obs/slo.py): currently-firing SLOs
+    # (latest firing event per name, cleared on state="clear") plus the
+    # lifetime fired count — carried across live-mode trims via base
+    slo_firing: Dict[str, dict] = dict((base or {}).get("slo_firing", {}))
+    slo_alerts = int((base or {}).get("alerts", 0))
     for ev in events:
         r = _rank_of(ev)
         kind = ev.get("kind")
@@ -1244,6 +1341,15 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
                 "pool_max_bytes": ev.get("pool_max_bytes"),
                 "builds": ev.get("builds"), "hits": ev.get("hits"),
                 "evictions": ev.get("evictions")}
+        elif kind == "slo_alert":
+            name = str(ev.get("slo"))
+            if ev.get("state") == "firing":
+                slo_alerts += 1
+                slo_firing[name] = {"burn": ev.get("burn"),
+                                    "target": ev.get("target"),
+                                    "mode": ev.get("mode")}
+            else:
+                slo_firing.pop(name, None)
     n_events = len(events)
     if base:
         n_events += base["n_events"]
@@ -1263,11 +1369,15 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
                  "admissions": serve_admissions,
                  "last_admission": serve_last_admission,
                  "pool": serve_pool}
+    slo = None
+    if slo_alerts or slo_firing:
+        slo = {"alerts_total": slo_alerts, "firing": slo_firing}
     return {"ident": ident, "ranks": ranks, "n_events": n_events,
             "now": now, "window_s": window_s, "per_rank": per_rank,
             "phases": phases_summary(events), "solver": solver,
             "solver_done": solver_done, "straggler": strag,
-            "health": health, "drift": drift, "serve": serve}
+            "health": health, "drift": drift, "serve": serve,
+            "slo": slo}
 
 
 def _fmt_rate(n: int, window_s: float) -> str:
@@ -1384,6 +1494,23 @@ def render_watch(state: dict) -> str:
                 f"builds {pool.get('builds', 0)}, "
                 f"hits {pool.get('hits', 0)}, "
                 f"evictions {pool.get('evictions', 0)}")
+    slo = state.get("slo")
+    if slo:
+        # the SLO/alerts panel: appended ONLY when an alert ever fired,
+        # so the golden frame of alert-free runs stays byte-identical
+        firing = slo.get("firing") or {}
+        if firing:
+            parts = []
+            for name, info in sorted(firing.items()):
+                burn = info.get("burn")
+                burn_txt = (f" (burn {burn}x)"
+                            if burn not in (None, "") else "")
+                parts.append(f"{name}{burn_txt}")
+            lines.append(f"slo       FIRING: " + ", ".join(parts)
+                         + f" | {slo['alerts_total']} alert(s) lifetime")
+        else:
+            lines.append(f"slo       ok (all clear) | "
+                         f"{slo['alerts_total']} alert(s) lifetime")
     return "\n".join(lines)
 
 
@@ -1593,6 +1720,99 @@ def _follow_poll(files: List[str], state: Dict[str, tuple],
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# slo / postmortem
+
+
+def _fmt_burn(b) -> str:
+    if b is None:
+        return "-"
+    if b == float("inf") or b == "inf":
+        return "inf"
+    return f"{float(b):.1f}x"
+
+
+def print_slo(statuses: List[dict]) -> None:
+    """Render the :func:`obs.slo.evaluate` status list: one row per SLO
+    (state, mode, resolved target, sample count) plus the per-window
+    burn against its threshold — the multi-window rule fires only when
+    every window exceeds its bound."""
+    print(f"{'SLO':<26} {'state':<9} {'mode':<10} {'target':>12} "
+          f"{'samples':>8}  burn (per window)")
+    for st in statuses:
+        tgt = st.get("target")
+        tgt_txt = "-" if tgt is None else f"{float(tgt):.6g}"
+        wins = ", ".join(
+            f"{w['window_s']:g}s {_fmt_burn(w['burn'])}/{w['max_burn']:g}x"
+            for w in st.get("windows") or [])
+        print(f"{st['name']:<26} {st['state']:<9} {st['mode']:<10} "
+              f"{tgt_txt:>12} {st['samples']:>8}  {wins}")
+    firing = [st["name"] for st in statuses if st["state"] == "firing"]
+    if firing:
+        print(f"\nFIRING: {', '.join(firing)}")
+    else:
+        print("\nno SLO firing")
+
+
+def scan_postmortems(path: str) -> List[dict]:
+    """Flight-recorder bundles of a run: ``rank_*/postmortem/*.json``
+    under a run directory (or one bundle file), each re-hashed against
+    the sha16 in its filename (the content-address contract of
+    ``obs/flight.py``).  Standalone — reads files, imports nothing."""
+    if os.path.isdir(path):
+        files = [f for f in sorted(glob.glob(os.path.join(
+            path, "rank_*", "postmortem", "*.json")))
+            if os.path.basename(f) != "context.json"]
+    else:
+        files = [path]
+    out = []
+    for f in files:
+        name = os.path.basename(f)
+        stem = name[: -len(".json")] if name.endswith(".json") else name
+        claimed = stem.rsplit("-", 1)[-1]
+        try:
+            with open(f, "rb") as fh:
+                data = fh.read()
+            valid = hashlib.sha256(data).hexdigest()[:16] == claimed
+            bundle = json.loads(data.decode())
+        except (OSError, ValueError) as e:
+            out.append({"path": f, "valid": False, "error": repr(e),
+                        "bundle": None})
+            continue
+        out.append({"path": f, "valid": valid, "bundle": bundle})
+    return out
+
+
+def print_postmortems(entries: List[dict]) -> None:
+    for e in entries:
+        b = e.get("bundle") or {}
+        mark = "ok " if e["valid"] else "BAD"
+        print(f"[{mark}] {e['path']}")
+        if not e["valid"]:
+            why = e.get("error") or ("content address mismatch - "
+                                     "torn write or tampering")
+            print(f"      verification FAILED ({why})")
+        if not b:
+            continue
+        print(f"      reason={b.get('reason')} exit_code={b.get('exit_code')}"
+              f" signum={b.get('signum')} rank={b.get('rank')}"
+              f"/{b.get('n_ranks')}")
+        ident = (f"trace_id={b.get('trace_id')}"
+                 + (f" job_id={b.get('job_id')}" if b.get("job_id") else ""))
+        print(f"      {ident}")
+        if b.get("span_path"):
+            print(f"      died in: {b['span_path']}")
+        sp = b.get("span") or {}
+        if sp:
+            attrs = " ".join(f"{k}={v}" for k, v in sorted(sp.items())
+                             if k not in ("name", "kind", "span_id"))
+            print(f"      deepest span: {sp.get('name')}"
+                  + (f" ({attrs})" if attrs else ""))
+        evs = b.get("events") or []
+        print(f"      {len(evs)} ring event(s), "
+              f"{len(b.get('open_spans') or [])} open span(s)")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="obs_report", description=__doc__.split("\n")[0])
@@ -1683,6 +1903,25 @@ def main(argv=None) -> int:
     p.add_argument("--follow", action="store_true",
                    help="keep reading as the run appends")
 
+    p = sub.add_parser("slo", help="burn-rate SLO evaluation over a "
+                                   "recorded run (exit 1 when firing)")
+    p.add_argument("run", help="run dir or .jsonl event file")
+    p.add_argument("--target", action="append", default=None,
+                   metavar="NAME=VALUE",
+                   help="pin an explicit SLO objective by name "
+                        "(repeatable; e.g. steady_apply_ms=12.5 — "
+                        "unpinned thresholds self-baseline from the "
+                        "run's earliest quartile)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable status list")
+
+    p = sub.add_parser("postmortem", help="read crash flight-recorder "
+                                          "bundles (rank_*/postmortem/)")
+    p.add_argument("run", help="run dir (all ranks scanned) or one "
+                               "bundle .json")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable bundle list")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -1770,6 +2009,38 @@ def main(argv=None) -> int:
 
     if args.cmd == "watch":
         return watch_run(args.run, args.once, args.interval, args.window)
+
+    if args.cmd == "slo":
+        targets = {}
+        for t in args.target or []:
+            name, sep, val = t.partition("=")
+            if not sep:
+                ap.error(f"--target expects NAME=VALUE, got {t!r}")
+            try:
+                targets[name] = float(val)
+            except ValueError:
+                ap.error(f"--target {name}: not a number: {val!r}")
+        slo_mod = _load_slo()
+        statuses = slo_mod.evaluate(load_events(args.run),
+                                    slo_mod.default_slos(targets))
+        if args.json:
+            print(json.dumps(statuses, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            print_slo(statuses)
+        return 1 if any(st["state"] == "firing" for st in statuses) else 0
+
+    if args.cmd == "postmortem":
+        entries = scan_postmortems(args.run)
+        if args.json:
+            print(json.dumps(entries, indent=1, sort_keys=True))
+        else:
+            print_postmortems(entries)
+        if not entries:
+            print(f"postmortem: no bundles under {args.run} (no crash "
+                  "recorded — a clean run leaves none)", file=sys.stderr)
+            return 2
+        return 0 if all(e["valid"] for e in entries) else 1
 
     if args.cmd == "diff":
         base = bench_metrics(load_events(args.base))
