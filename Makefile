@@ -15,7 +15,7 @@ HEALTH_THRESHOLD ?= 0.02
 	obs-check health-check mem-check stream-check fault-check \
 	roofline-check compress-check trace-check pipeline-check \
 	hybrid-check serve-check elastic-check dynamics-check tune-check \
-	slo-check clean
+	slo-check profile-check clean
 
 check:
 	$(PYTHON) -m pytest tests/ -q
@@ -34,6 +34,7 @@ check:
 	$(MAKE) elastic-check
 	$(MAKE) tune-check
 	$(MAKE) slo-check
+	$(MAKE) profile-check
 
 check-fast:
 	$(PYTHON) -m pytest tests/ -q -x -k "not distributed and not reference"
@@ -252,6 +253,23 @@ tune-check:
 	      "timing noise vs a genuine break resolves by attempt 3)"; \
 	  fi; \
 	done; exit $$ok
+
+# Continuous-profiling gate (tools/profile_check.py, DESIGN.md §32):
+# every precompile() miss records an HLO cost profile whose phase
+# buckets sum EXACTLY to the executable's cost_analysis() totals,
+# content-addressed next to the XLA cache and round-tripping through
+# load_profile; the apply HLO is byte-identical with
+# DMT_PROFILE=sampled vs off; sampled trace windows at a cadence priced
+# from the rig's own measured capture cost stay under the 2% overhead
+# budget (re-priced and retried in-process — the capture stop cost is
+# noisy on a shared host); `obs_report roofline` gains the hlo-ms third
+# column summing to the measured wall; a forced bench_trend gate
+# failure triggers a flight-recorder bundle naming the hottest ops; and
+# tools/profile_diff.py passes on a self-diff then FIRES naming a
+# synthetically 10x-regressed op in its top rows.  ~60 s on the CPU rig
+# (the overhead leg must amortize real profiler captures).
+profile-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/profile_check.py
 
 # Numerical-health gate (tools/health_check.py): chain-16 smoke applies
 # with probes on vs off in ONE process (same warm engine — cross-process

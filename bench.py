@@ -370,6 +370,11 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     x /= np.linalg.norm(x)
     y_ref = None
     y_stream = None
+    # profiling-plane baselines (ISSUE 19): this config's hlo_cost
+    # events and overhead-ledger deltas become its hlo_flops/hlo_bytes/
+    # profile_overhead_pct trend columns
+    n_hlo0 = len(obs.events("hlo_cost"))
+    prof_ov0 = obs.overhead_snapshot()
     cfg = get_config()
     saved_tier = cfg.stream_compress
     saved_tune = cfg.tune
@@ -542,6 +547,22 @@ def _bench_stream_impl(name, basis_args, repeats=5, edges=None, n_devices=1,
     out["hybrid_steady_speedup"] = round(
         out["fused_steady_apply_ms"]
         / max(out["hybrid_steady_apply_ms"], 1e-9), 2)
+    # whole-program HLO cost totals for the executables this config
+    # compiled (every precompile left one hlo_cost event), plus the
+    # measured profiling overhead across its applies — exactly 0.0 with
+    # DMT_PROFILE=off, where the overhead ledger never runs
+    hev = obs.events("hlo_cost")[n_hlo0:]
+    if hev:
+        out["hlo_flops"] = round(
+            sum(float(e.get("flops") or 0.0) for e in hev), 1)
+        out["hlo_bytes"] = round(
+            sum(float(e.get("bytes") or 0.0) for e in hev), 1)
+    prof_ov1 = obs.overhead_snapshot()
+    extra_ms = prof_ov1["extra_ms"] - prof_ov0["extra_ms"]
+    base_ms = (prof_ov1["apply_ms"] - prof_ov0["apply_ms"]) - extra_ms
+    out["profile_overhead_pct"] = round(
+        100.0 * extra_ms / base_ms, 4) if (base_ms > 0
+                                           and extra_ms > 0) else 0.0
     obs.emit("bench_result", **out)
     return out
 
@@ -1163,6 +1184,24 @@ def _main():
             trend_path = args.trend_out or bench_trend.default_progress_path()
             if rec["configs"] and bench_trend.append_record(trend_path, rec):
                 line["trend_file"] = os.path.basename(trend_path)
+                # in-process trend gate (ISSUE 19): a failing gate on the
+                # record just appended triggers one deep profile capture
+                # (flight bundle with the hottest HLO ops) so the
+                # regression ships its own diagnosis; soft-fail like the
+                # ledger itself
+                try:
+                    _, regs, _ = bench_trend.gate(
+                        bench_trend.load_records(trend_path), 0.3)
+                    if regs:
+                        line["trend_regressions"] = len(regs)
+                        obs.trigger_capture(
+                            "trend_gate",
+                            regressions=[dict(zip(
+                                ("config", "metric", "baseline",
+                                 "value", "rel_change"), r))
+                                for r in regs[:8]])
+                except Exception as e:
+                    _progress(f"trend gate skipped: {e!r}")
         except Exception as e:      # the ledger must never cost the run
             _progress(f"trend append skipped: {e!r}")
 

@@ -59,6 +59,11 @@ from .flight import (flight_dump, install_fatal_handlers, list_bundles,
 from .health import (HealthError, drain as drain_health, health_event_count,
                      health_mode, probes_enabled, record as record_health,
                      reset_health)
+from .hlo import (diff_profiles, executable_costs, hottest_ops,
+                  load_profile, record_executable_costs, reset_hlo)
+from .profile import (measured_overhead_pct, overhead_snapshot,
+                      profile_due, profile_mode, reset_profile,
+                      sample_window, stamp_profile_dir, trigger_capture)
 from .memory import (MemoryReport, OomError, attach_oom,
                      build_memory_report, emit_ledger, executable_analyses,
                      last_watermark, ledger_entries, ledger_total,
@@ -145,6 +150,20 @@ __all__ = [
     "default_slos",
     "evaluate_slos",
     "reset_slo",
+    "diff_profiles",
+    "executable_costs",
+    "hottest_ops",
+    "load_profile",
+    "record_executable_costs",
+    "reset_hlo",
+    "measured_overhead_pct",
+    "overhead_snapshot",
+    "profile_due",
+    "profile_mode",
+    "reset_profile",
+    "sample_window",
+    "stamp_profile_dir",
+    "trigger_capture",
 ]
 
 
@@ -157,8 +176,9 @@ def snapshot() -> dict:
 
 
 def reset_all() -> None:
-    """Reset events, metrics, health, memory, trace, SLO and flight state
-    (test isolation helper); also stops a running exporter."""
+    """Reset events, metrics, health, memory, trace, SLO, flight, HLO
+    and profiling state (test isolation helper); also stops a running
+    exporter."""
     stop_exporter()
     reset()
     reset_metrics()
@@ -167,3 +187,5 @@ def reset_all() -> None:
     reset_trace()
     reset_slo()
     reset_flight()
+    reset_hlo()
+    reset_profile()

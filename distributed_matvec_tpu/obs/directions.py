@@ -36,6 +36,14 @@ knob search's own cost, or the hand-set bar growing is the regression —
 and deliberately fall through to the default;
 ``autotuned_steady_speedup`` carries the ``speedup`` tag, so shrinkage
 gates as the regression under the existing rule.
+
+The profiling plane's metrics (``make profile-check``, DESIGN.md §32)
+register the same way: ``hlo_flops`` and ``hlo_bytes`` are the compiled
+apply's whole-program cost-analysis totals — the program getting more
+expensive is the regression — and ``profile_overhead_pct`` is the
+measured cost of observing (trace start/stop over un-profiled apply
+wall), a pure cost; all three deliberately fall through to the
+cost-like default.
 """
 
 from __future__ import annotations
@@ -104,6 +112,18 @@ METRIC_HELP = {
     "serve_batch_width": "Jobs packed into the in-flight solver batch",
     "slo_alert_count": "SLO burn-rate alerts fired (lifetime)",
     "flight_dump_count": "Flight-recorder post-mortem bundles written",
+    "hlo_profile_count": "HLO cost profiles captured at compile time",
+    "profile_capture_count": "Profiler trace captures by kind "
+                             "(sampled/triggered/manual)",
+    "profile_overhead_latch_count":
+        "Sampled profiling latched off by the overhead guard",
+    "hlo_flops": "Whole-program flops from the compiled apply's "
+                 "HLO cost analysis",
+    "hlo_bytes": "Whole-program bytes accessed from the compiled "
+                 "apply's HLO cost analysis",
+    "profile_overhead_pct": "Measured profiling overhead (trace "
+                            "start/stop cost over un-profiled "
+                            "apply wall, percent)",
 }
 
 

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -56,6 +56,7 @@ __all__ = [
     "choose_pipeline_depth",
     "price_term_split",
     "choose_hybrid_split",
+    "hlo_phase_split",
     "roofline_report",
     "print_roofline",
     "reconcile_error",
@@ -370,6 +371,52 @@ def _mean(vals: List[float]) -> float:
     return sum(vals) / len(vals) if vals else 0.0
 
 
+def _price_hlo_phase(phase: str, byts: float, flops: float,
+                     cal: dict) -> float:
+    """Seconds the calibrated rates would charge one HLO phase bucket:
+    exchange moves bytes over the interconnect, compute burns flops
+    (falling back to movement when the bucket attributed none), and
+    everything else stages bytes at the H2D rate.  Only the CROSS-phase
+    ratios matter — :func:`hlo_phase_split` renormalizes to the
+    measured wall."""
+    h = float(cal.get("h2d_bytes_per_s") or 0.0) or 1e9
+    x = float(cal.get("exchange_bytes_per_s") or 0.0) or h
+    f = float(cal.get("flops_per_s") or 0.0) or 1e9
+    if phase == "exchange":
+        return byts / x
+    if phase.startswith("compute"):
+        return flops / f if flops > 0 else byts / h
+    return byts / h
+
+
+def hlo_phase_split(event: dict, group_phases: Sequence[str],
+                    wall_ms: float, cal: dict) -> Dict[str, float]:
+    """The third roofline column: split the measured apply wall by the
+    compiled executable's HLO cost table (``hlo_cost`` event).  Each
+    ``phase_bytes_*``/``phase_flops_*`` bucket is priced at the
+    calibrated rates, buckets missing from the measured group fold into
+    its compute phase, and the priced shares are normalized so
+    Σ ``hlo_ms`` ≡ the measured wall — the *signal* is the per-phase
+    split, reconciled by construction."""
+    priced: Dict[str, float] = {}
+    for k, v in event.items():
+        if not k.startswith("phase_bytes_"):
+            continue
+        ph = k[len("phase_bytes_"):]
+        byts = float(v or 0.0)
+        flops = float(event.get(f"phase_flops_{ph}") or 0.0)
+        target = ph if ph in group_phases else (
+            "compute" if "compute" in group_phases else None)
+        if target is None:
+            continue
+        priced[target] = (priced.get(target, 0.0)
+                          + _price_hlo_phase(ph, byts, flops, cal))
+    total = sum(priced.values())
+    if total <= 0.0 or wall_ms <= 0.0:
+        return {}
+    return {p: wall_ms * s / total for p, s in priced.items()}
+
+
 def roofline_report(events: List[dict],
                     calibration: Optional[dict] = None) -> dict:
     """The full roofline report for one run: per (engine, mode) group the
@@ -503,6 +550,35 @@ def roofline_report(events: List[dict],
                 grp["tuned_token"] = str(match[-1].get("token"))
                 grp["tuned_priced_ms"] = float(
                     match[-1].get("priced_ms") or 0.0)
+    # HLO third column (ISSUE 19): every compiled apply left one
+    # `hlo_cost` event; match it to its group by the program name the
+    # compile path uses (f"{engine}_{mode}_apply") and split the
+    # measured wall by the HLO cost table so each phase row shows
+    # priced-vs-HLO-vs-measured side by side
+    hlo_by_program: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("kind") == "hlo_cost":
+            hlo_by_program[str(ev.get("program"))] = ev   # newest wins
+    if hlo_by_program:
+        for key, grp in out["groups"].items():
+            engine, _, mode = key.split("+pipe", 1)[0].partition("/")
+            ev = hlo_by_program.get(f"{engine}_{mode}_apply")
+            if ev is None:
+                continue
+            split = hlo_phase_split(ev, tuple(grp["phases"]),
+                                    float(grp["wall_ms"]), cal)
+            if not split:
+                continue
+            for p, v in split.items():
+                grp["phases"][p]["hlo_ms"] = round(v, 4)
+            grp["hlo"] = {
+                "program": str(ev.get("program")),
+                "fingerprint": str(ev.get("fingerprint", ""))[:16],
+                "flops": float(ev.get("flops") or 0.0),
+                "bytes": float(ev.get("bytes") or 0.0),
+                "n_ops": int(ev.get("n_ops") or 0),
+                "artifact": str(ev.get("artifact") or ""),
+            }
     return out
 
 
@@ -534,8 +610,13 @@ def print_roofline(report: dict) -> None:
         print(f"\n{name}: {grp['steady_applies']} steady applies, "
               f"wall {grp['wall_ms']:.3f} ms/apply, "
               f"{grp['chunks']} chunk(s)")
+        # third column only when this run captured HLO cost profiles —
+        # reports from older runs render byte-identically
+        has_hlo = any(a.get("hlo_ms") is not None
+                      for a in grp["phases"].values())
         print(f"  {'phase':<12} {'wall ms':>10} {'bound ms':>10} "
-              f"{'achieved':>9} {'bytes':>14} {'gathers':>12}")
+              + (f"{'hlo ms':>10} " if has_hlo else "")
+              + f"{'achieved':>9} {'bytes':>14} {'gathers':>12}")
         for p in PHASES:
             a = grp["phases"].get(p)
             if a is None:
@@ -550,10 +631,21 @@ def print_roofline(report: dict) -> None:
                 cell = "hidden"
             else:
                 cell = f"{ach:.1%}"
+            hlo_cell = ""
+            if has_hlo:
+                hv = a.get("hlo_ms")
+                hlo_cell = (f"{hv:>10.4f} " if hv is not None
+                            else f"{'-':>10} ")
             print(f"  {p:<12} {a['wall_ms']:>10.4f} {a['bound_ms']:>10.4f} "
-                  f"{cell:>9} "
+                  + hlo_cell
+                  + f"{cell:>9} "
                   f"{a['bytes']:>14,} {a['gathers']:>12,}"
                   + ("  (measured)" if a.get("measured") else ""))
+        if grp.get("hlo"):
+            h = grp["hlo"]
+            print(f"  hlo: {h['program']} [{h['fingerprint']}] "
+                  f"{h['n_ops']} ops, {h['flops']:.3g} flops, "
+                  f"{h['bytes']:.3g} bytes accessed")
         frac = grp.get("roofline_fraction")
         print(f"  binding resource: {grp['binding_resource']} "
               f"(phase {grp['binding_phase']}"

@@ -105,6 +105,7 @@ from ..models.operator import Operator
 from ..obs import annotate, counter, emit, histogram, obs_enabled
 from ..obs import trace as obs_trace
 from ..obs import health as obs_health
+from ..obs import profile as obs_profile
 from ..obs import memory as obs_memory
 from ..obs import phases as obs_phases
 from ..ops import kernels as K
@@ -3921,6 +3922,14 @@ class DistributedEngine:
         # mid-apply, and the re-key wall never pollutes the apply wall
         if self._retune_pending is not None:
             self.maybe_retune()
+        # sampled continuous profiling: every profile_every-th apply runs
+        # inside a bounded jax.profiler trace window (obs/profile.py);
+        # off-mode is a single branch and the apply program is untouched
+        # either way — the profiler observes, it never rewrites
+        with obs_profile.sample_window("distributed", self._apply_idx):
+            return self._matvec_inner(xh, check)
+
+    def _matvec_inner(self, xh, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()

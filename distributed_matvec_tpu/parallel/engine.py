@@ -47,6 +47,8 @@ from ..obs import phases as obs_phases
 from ..obs import trace as obs_trace
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
+from ..obs import hlo as obs_hlo
+from ..obs import profile as obs_profile
 from ..obs.events import obs_enabled
 from ..ops import kernels as K
 from ..ops.bits import build_sorted_lookup, state_index_bucketed
@@ -132,6 +134,11 @@ def precompile(name: str, statics: tuple, jit_fn, args, timer) -> Any:
         # argument/output/temp/generated-code bytes, emitted + persisted
         # next to the XLA artifact cache (obs/memory.py; no-op when off)
         obs_memory.record_executable_analysis(
+            _analysis_key(name, statics, shapes), ex, program=name)
+        # ... and the HLO cost profile: per-op flops/bytes attributed
+        # into the §22 phase taxonomy, content-addressed by the
+        # optimized HLO text (obs/hlo.py; no-op when off)
+        obs_hlo.record_executable_costs(
             _analysis_key(name, statics, shapes), ex, program=name)
     else:
         counter("aot_executable_cache", event="hit").inc()
@@ -448,6 +455,11 @@ def analyze_bound_apply(eng, engine_kind: str, x):
     ana = obs_memory.executable_analyses().get(key)
     if ana is None:
         ana = obs_memory.record_executable_analysis(key, ex, program=name)
+    # the HLO cost profile rides the same executable: a process-cache hit
+    # in precompile() skips the recording hooks, so backfill here exactly
+    # like the memory analysis above (no-op when already registered)
+    if obs_hlo.executable_costs().get(key) is None:
+        obs_hlo.record_executable_costs(key, ex, program=name)
     return ana
 
 
@@ -1434,6 +1446,14 @@ class LocalEngine:
             return self._matvec_body(x, check)
 
     def _matvec_body(self, x, check: Optional[bool] = None) -> jax.Array:
+        # sampled continuous profiling: every profile_every-th apply runs
+        # inside a bounded jax.profiler trace window (obs/profile.py);
+        # off-mode is a single branch and the apply program is untouched
+        # either way — the profiler observes, it never rewrites
+        with obs_profile.sample_window("local", self._apply_idx):
+            return self._matvec_inner(x, check)
+
+    def _matvec_inner(self, x, check: Optional[bool] = None) -> jax.Array:
         # telemetry measures eager *dispatch* wall time only (async queue —
         # NO block_until_ready here: recording must never add a sync)
         _t0 = time.perf_counter()

@@ -73,6 +73,23 @@ class RuntimeConfig:
     #   (host-side structural counts only — the apply HLO is byte-identical
     #   on or off, guard-tested by `make roofline-check`); "off" disables
     #   the events (obs off implies off)
+    profile: str = "off"                   # continuous profiling plane
+    #   (DMT_PROFILE, obs/profile.py): "sampled" captures a bounded
+    #   jax.profiler trace window every profile_every-th eager apply into
+    #   <run_dir>/rank_<r>/profiles/ (plus triggered deep capture);
+    #   "triggered" keeps only the incident-driven capture path; "off"
+    #   (default) is a provable no-op — the apply HLO is byte-identical
+    #   on or off, guard-tested by `make profile-check`
+    profile_every: int = 64                # sampled-profile cadence
+    #   (DMT_PROFILE_EVERY): every Nth eager apply runs inside a trace
+    #   window when profile=sampled — same cadence pattern as
+    #   health_every, skipping apply 0 (compile noise)
+    profile_overhead_pct: float = 2.0      # measured-overhead budget
+    #   (DMT_PROFILE_OVERHEAD_PCT): when the trace windows' own measured
+    #   start/stop cost exceeds this percent of the un-profiled apply
+    #   wall (after ≥2 windows), sampling latches OFF for the process
+    #   and emits `profile_overhead_latch` — profiling must never become
+    #   the regression it is hunting
 
     # -- enumeration (CommonParameters.chpl:5-6) ----------------------------
     is_representative_batch_size: int = 10240   # kIsRepresentativeBatchSize

@@ -30,6 +30,12 @@ def maybe_profile(create_perfetto_link: bool = False,
     config into its own directory without mutating process-global config or
     env vars.  An explicit empty string forces the no-op regardless of the
     config field; ``None`` (default) defers to the config.
+
+    Part of the continuous-profiling plane (obs/profile.py): a captured
+    trace directory is stamped with ``PROFILE_META.json``
+    (trace_id/job_id) and announced by a ``profile_captured`` event, so
+    manual profiles are discoverable from the event stream instead of
+    being orphan directories.
     """
     d = profile_dir if profile_dir is not None else get_config().profile_dir
     if not d:
@@ -39,3 +45,14 @@ def maybe_profile(create_perfetto_link: bool = False,
 
     with jax.profiler.trace(d, create_perfetto_link=create_perfetto_link):
         yield
+    # stamp + announce AFTER the trace closes (its files exist now);
+    # soft-fail — a broken obs layer must not break the profiled block
+    try:
+        from ..obs.events import emit, obs_enabled
+        from ..obs.profile import stamp_profile_dir
+
+        if obs_enabled():
+            stamp_profile_dir(d, capture="manual")
+            emit("profile_captured", capture="manual", dir=d)
+    except Exception:
+        pass

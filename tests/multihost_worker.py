@@ -428,6 +428,45 @@ if os.environ.get("DMT_MH_FAST"):
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
     sys.exit(0)
 
+if os.environ.get("DMT_MH_PROF"):
+    # Continuous-profiling leg (tests/test_profile.py, DESIGN.md §32):
+    # each rank of a REAL 2-process job AOT-analyzes the same rank-local
+    # ell apply program, recording its HLO cost profile.  The profile is
+    # content-addressed by the optimized HLO text, so agreement is
+    # structural: both ranks must print the same fingerprint and totals
+    # (the parent asserts it) and their artifacts land on the SAME
+    # content-addressed path in the shared artifact root — a fleet whose
+    # ranks compile different apply programs cannot agree.  Correctness
+    # still asserted so a broken apply cannot masquerade as a profiling
+    # pass.
+    from distributed_matvec_tpu import obs
+    from distributed_matvec_tpu.parallel.mesh import make_mesh
+
+    eng = DistributedEngine(op, mesh=make_mesh(devices=jax.local_devices()),
+                            mode="ell")
+    xh = eng.to_hashed(x)
+    err = float(np.abs(eng.from_hashed(eng.matvec(xh)) - want).max())
+    print(f"[p{pid}] prof ell: matvec max err {err:.3e}", flush=True)
+    assert err < 1e-12, err
+    eng.apply_memory_analysis(xh)
+    profs = [p for p in obs.executable_costs().values()
+             if p["program"] == "distributed_ell_apply"]
+    assert len(profs) == 1, sorted(
+        p["program"] for p in obs.executable_costs().values())
+    prof = profs[0]
+    t = prof["totals"]
+    for axis in ("bytes", "flops"):
+        s = sum(row[axis] for row in prof["phases"].values())
+        assert abs(s - t[axis]) < 0.5, (axis, s, t[axis])
+    art = prof.get("artifact", "")
+    assert art and os.path.exists(art), \
+        f"no content-addressed profile artifact ({art!r})"
+    print(f"[p{pid}] PROF_OK {prof['fingerprint']} {t['flops']:.0f} "
+          f"{t['bytes']:.0f} {os.path.basename(art)}", flush=True)
+    _finish_obs()
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+    sys.exit(0)
+
 for mode in ("ell", "compact", "fused"):
     eng = DistributedEngine(op, n_devices=4 * nproc, mode=mode)
     y = eng.from_hashed(eng.matvec(eng.to_hashed(x)))

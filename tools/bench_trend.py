@@ -76,6 +76,7 @@ METRIC_WHITELIST = (
     "kpm_apply_ms", "evolve_steps_per_s", "evolve_norm_drift",
     "evolve_energy_drift", "evolve_steps",
     "slo_alert_count",
+    "hlo_flops", "hlo_bytes", "profile_overhead_pct",
 )
 
 #: Default gated metrics (exact names; ``*`` suffix = prefix match, as in
@@ -134,7 +135,16 @@ DEFAULT_GATE = ("device_ms", "streamed_steady_apply_ms",
                 # gated ZERO-TOLERANTLY below — the healthy baseline is
                 # exactly 0, which the relative gate would skip, so any
                 # alert on a previously alert-free config regresses
-                "slo_alert_count")
+                "slo_alert_count",
+                # measured profiling overhead (obs/profile.py ledger,
+                # cost-like percent under the shared direction table):
+                # a PR whose instrumentation starts costing real apply
+                # time fails the gate even when the walls themselves
+                # still squeak under their own bounds.  Off-mode runs
+                # record 0.0 (skipped as a baseline); the min-baseline
+                # floor below keeps sub-quarter-percent jitter from
+                # gating noise
+                "profile_overhead_pct")
 
 #: Incident counters whose healthy baseline is exactly zero: gated
 #: absolutely (any increase beyond threshold x baseline regresses, so a
@@ -152,7 +162,10 @@ GATE_MIN_BASELINE = {"barrier_ms": 1.0,
                      # of a second; sub-50 ms baselines are scheduler
                      # jitter, not a trajectory
                      "resume_reshard_s": 0.05,
-                     "resume_rebuild_plan_s": 0.05}
+                     "resume_rebuild_plan_s": 0.05,
+                     # measured profiling overhead under a quarter
+                     # percent is timer jitter, not a trajectory
+                     "profile_overhead_pct": 0.25}
 
 
 def _keep(metric: str) -> bool:

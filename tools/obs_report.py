@@ -174,6 +174,51 @@ def _load_slo():
     return mod
 
 
+def _load_hlo():
+    """File-load ``obs/hlo.py`` (same pattern as the SLO module): its
+    import-dual header falls back to the pure parse/attribute/diff
+    surface, so the ``profile`` subcommand never imports the package
+    (and therefore never initializes a JAX backend just to diff two
+    JSON cost tables)."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "distributed_matvec_tpu", "obs", "hlo.py")
+    spec = importlib.util.spec_from_file_location("dmt_obs_hlo", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve_profile(hlo_mod, path: str,
+                     program: Optional[str] = None) -> Optional[dict]:
+    """Resolve a ``profile`` subcommand argument to one profile dict:
+    a profile-artifact ``.json`` loads directly; a run directory or
+    ``.jsonl`` stream resolves through its ``hlo_cost`` events to the
+    newest artifact (optionally filtered by ``program`` substring)."""
+    if os.path.isfile(path) and path.endswith(".json"):
+        try:
+            return hlo_mod.load_profile(path)
+        except (ValueError, json.JSONDecodeError):
+            pass                     # not an artifact: fall through
+    try:
+        events = load_events(path)
+    except Exception:
+        return None
+    cands = [e for e in events if e.get("kind") == "hlo_cost"]
+    if program:
+        cands = [e for e in cands if program in str(e.get("program"))]
+    for ev in reversed(cands):
+        art = str(ev.get("artifact") or "")
+        if art and os.path.isfile(art):
+            try:
+                return hlo_mod.load_profile(art)
+            except (ValueError, json.JSONDecodeError):
+                continue
+    return None
+
+
 _DEFAULT_GATE = ("device_ms",)
 
 # the memory-regression gate (`diff --memory`): all cost-like, so the
@@ -521,10 +566,54 @@ def run_summary(events: List[dict]) -> dict:
                        "events": health_events},
             "slo": {"alerts": slo_alerts, "counters": slo_counters,
                     "flight_dumps": flight_dumps},
+            "profile": profile_summary(events),
             "memory": memory_summary(events),
             "phases": phases_summary(events),
             "bench": bench_metrics(events),
             "solvers": solvers}
+
+
+def profile_summary(events: List[dict]) -> Optional[dict]:
+    """Digest of the continuous-profiling plane's events: the newest
+    HLO cost profile per compiled program (``hlo_cost``), trace-capture
+    counts per kind (``profile_captured``), and whether the overhead
+    guard latched sampling off.  None for runs that never profiled —
+    the summary stays byte-identical for them."""
+    hlo: Dict[str, dict] = {}
+    captures: Dict[str, int] = {}
+    latch = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "hlo_cost":
+            hlo[str(ev.get("program"))] = ev     # newest wins
+        elif kind == "profile_captured":
+            cap = str(ev.get("capture") or "unknown")
+            captures[cap] = captures.get(cap, 0) + 1
+        elif kind == "profile_overhead_latch":
+            latch = {"overhead_pct": ev.get("overhead_pct"),
+                     "budget_pct": ev.get("budget_pct")}
+    if not hlo and not captures and latch is None:
+        return None
+    out: Dict[str, object] = {
+        "programs": {p: {"fingerprint": str(e.get("fingerprint", ""))[:16],
+                         "flops": e.get("flops"),
+                         "bytes": e.get("bytes"),
+                         "n_ops": e.get("n_ops"),
+                         "artifact": e.get("artifact", "")}
+                     for p, e in sorted(hlo.items())},
+        "captures": captures,
+    }
+    if hlo:
+        newest = max(hlo.values(), key=lambda e: e.get("seq", 0))
+        out["newest"] = {
+            "program": str(newest.get("program")),
+            "fingerprint": str(newest.get("fingerprint", ""))[:16],
+            "artifact": str(newest.get("artifact") or ""),
+            "top_ops": list(newest.get("top_ops") or [])[:3],
+        }
+    if latch is not None:
+        out["latched"] = latch
+    return out
 
 
 def _fmt_seconds(v) -> str:
@@ -595,6 +684,11 @@ def print_summary(s: dict) -> None:
             print(f"  flight_dump rank {fd.get('rank')}: "
                   f"{fd.get('reason')} (exit {fd.get('exit_code')})"
                   f"{where} -> {fd.get('bundle')}")
+    prof = s.get("profile")
+    if prof:
+        # conditional by design: runs that never profiled summarize
+        # exactly as before this section existed
+        print_profile_section(prof)
     mem = s.get("memory") or {}
     if any(mem.get(k) for k in ("top_allocations", "peak_hbm_bytes",
                                 "executables", "oom_events")):
@@ -633,6 +727,38 @@ def _fmt_bytes(b) -> str:
             return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
         b /= 1024
     return f"{b:.1f} GB"
+
+
+def print_profile_section(prof: dict) -> None:
+    """Render the :func:`profile_summary` digest: newest HLO cost
+    artifact + top-3 hottest ops, per-program cost totals, capture
+    counts, and the overhead latch if it fired."""
+    print("\nprofiling (hlo cost attribution / trace captures):")
+    newest = prof.get("newest")
+    if newest:
+        print(f"  newest profile: {newest['program']} "
+              f"[{newest['fingerprint']}]"
+              + (f" -> {newest['artifact']}" if newest.get("artifact")
+                 else ""))
+        for o in newest.get("top_ops") or []:
+            print(f"    hot op {o.get('name'):<32} {o.get('opcode'):<20} "
+                  f"{o.get('phase'):<12} "
+                  f"bytes={_fmt_bytes(o.get('bytes'))} "
+                  f"flops={float(o.get('flops') or 0.0):.3g}")
+    for p, rec in sorted((prof.get("programs") or {}).items()):
+        print(f"  {p:<36} [{rec.get('fingerprint')}] "
+              f"{rec.get('n_ops')} ops  "
+              f"flops={float(rec.get('flops') or 0.0):.3g}  "
+              f"bytes={_fmt_bytes(rec.get('bytes'))}")
+    caps = prof.get("captures") or {}
+    if caps:
+        print("  captures: " + "  ".join(f"{k}={v}" for k, v
+                                         in sorted(caps.items())))
+    if prof.get("latched"):
+        lt = prof["latched"]
+        print(f"  OVERHEAD LATCH: sampling off at "
+              f"{float(lt.get('overhead_pct') or 0.0):.2f}% measured "
+              f"(budget {float(lt.get('budget_pct') or 0.0):.2f}%)")
 
 
 def print_memory_section(mem: dict) -> None:
@@ -1276,6 +1402,10 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
     # lifetime fired count — carried across live-mode trims via base
     slo_firing: Dict[str, dict] = dict((base or {}).get("slo_firing", {}))
     slo_alerts = int((base or {}).get("alerts", 0))
+    # continuous-profiling state (obs/profile.py + obs/hlo.py): newest
+    # HLO cost profile seen and trace-capture counts per kind
+    prof_newest = None
+    prof_captures: Dict[str, int] = {}
     for ev in events:
         r = _rank_of(ev)
         kind = ev.get("kind")
@@ -1350,6 +1480,14 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
                                     "mode": ev.get("mode")}
             else:
                 slo_firing.pop(name, None)
+        elif kind == "hlo_cost":
+            prof_newest = {"program": str(ev.get("program")),
+                           "fingerprint": str(ev.get("fingerprint",
+                                                     ""))[:16],
+                           "top_ops": list(ev.get("top_ops") or [])[:3]}
+        elif kind == "profile_captured":
+            cap = str(ev.get("capture") or "unknown")
+            prof_captures[cap] = prof_captures.get(cap, 0) + 1
     n_events = len(events)
     if base:
         n_events += base["n_events"]
@@ -1372,12 +1510,15 @@ def watch_state(events, window_s: float = _WATCH_WINDOW_S,
     slo = None
     if slo_alerts or slo_firing:
         slo = {"alerts_total": slo_alerts, "firing": slo_firing}
+    profile = None
+    if prof_newest or prof_captures:
+        profile = {"newest": prof_newest, "captures": prof_captures}
     return {"ident": ident, "ranks": ranks, "n_events": n_events,
             "now": now, "window_s": window_s, "per_rank": per_rank,
             "phases": phases_summary(events), "solver": solver,
             "solver_done": solver_done, "straggler": strag,
             "health": health, "drift": drift, "serve": serve,
-            "slo": slo}
+            "slo": slo, "profile": profile}
 
 
 def _fmt_rate(n: int, window_s: float) -> str:
@@ -1511,6 +1652,23 @@ def render_watch(state: dict) -> str:
         else:
             lines.append(f"slo       ok (all clear) | "
                          f"{slo['alerts_total']} alert(s) lifetime")
+    prof = state.get("profile")
+    if prof:
+        # the profiling panel: appended ONLY when the run captured an
+        # HLO cost profile or a trace window, so the golden frame of
+        # profile-less runs stays byte-identical
+        newest = prof.get("newest")
+        parts = []
+        if newest:
+            hot = ",".join(str(o.get("name")) for o in
+                           (newest.get("top_ops") or []))
+            parts.append(f"{newest['program']} [{newest['fingerprint']}]"
+                         + (f" hot: {hot}" if hot else ""))
+        caps = prof.get("captures") or {}
+        if caps:
+            parts.append("captures: " + ", ".join(
+                f"{v} {k}" for k, v in sorted(caps.items())))
+        lines.append("profile   " + " | ".join(parts))
     return "\n".join(lines)
 
 
@@ -1858,6 +2016,28 @@ def main(argv=None) -> int:
                         "DESIGN.md §2 documented defaults")
     p.add_argument("--json", action="store_true")
 
+    p = sub.add_parser("profile", help="HLO cost profile of a run's "
+                                       "compiled applies; with a second "
+                                       "argument, an op-by-op "
+                                       "differential diff (exit 1 on "
+                                       "gated regression)")
+    p.add_argument("base", help="profile artifact .json, run dir, or "
+                                ".jsonl with hlo_cost events")
+    p.add_argument("new", nargs="?", default=None,
+                   help="candidate (same forms) — omit to just render "
+                        "the base profile")
+    p.add_argument("--program", default=None, metavar="SUBSTR",
+                   help="select by program-name substring when a run "
+                        "compiled several (default: the newest)")
+    p.add_argument("--threshold", type=float, default=0.25,
+                   help="per-op relative growth that gates as a "
+                        "regression (default 0.25; all HLO costs are "
+                        "cost-like — growth is the regression)")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows per table (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable profile/diff dict")
+
     p = sub.add_parser("diff", help="two runs -> regression report "
                                     "(exit 1 on gated regression)")
     p.add_argument("base", help="baseline run (dir/.jsonl/.json)")
@@ -1984,6 +2164,46 @@ def main(argv=None) -> int:
             print(json.dumps(report, indent=1, sort_keys=True))
         else:
             _roofline.print_roofline(report)
+        return 0
+
+    if args.cmd == "profile":
+        hlo_mod = _load_hlo()
+        base = _resolve_profile(hlo_mod, args.base, args.program)
+        if base is None:
+            print(f"profile: no hlo profile in {args.base} — compile "
+                  "with the obs + artifact layers on (both default on) "
+                  "so precompile() writes hlo-profile artifacts",
+                  file=sys.stderr)
+            return 2
+        if not args.new:
+            if args.json:
+                print(json.dumps(base, indent=1, sort_keys=True))
+            else:
+                hlo_mod.print_profile(base, top=args.top)
+            return 0
+        new = _resolve_profile(hlo_mod, args.new, args.program)
+        if new is None:
+            print(f"profile: no hlo profile in {args.new}",
+                  file=sys.stderr)
+            return 2
+        diff = hlo_mod.diff_profiles(base, new,
+                                     threshold=args.threshold,
+                                     top=args.top)
+        if args.json:
+            print(json.dumps(diff, indent=1, sort_keys=True))
+        else:
+            print(f"base {base.get('program')} "
+                  f"[{str(base.get('fingerprint', ''))[:16]}]  ->  "
+                  f"new {new.get('program')} "
+                  f"[{str(new.get('fingerprint', ''))[:16]}]")
+            hlo_mod.print_profile_diff(diff)
+        if diff["regressions"]:
+            if not args.json:
+                print(f"\nREGRESSION: {len(diff['regressions'])} "
+                      f"op-axis(es) grew beyond {args.threshold:.0%}")
+            return 1
+        if not args.json:
+            print(f"\nno per-op regression beyond {args.threshold:.0%}")
         return 0
 
     if args.cmd == "trace":
